@@ -1,0 +1,44 @@
+"""Lifecycle of host-side services attached at ``init()`` time.
+
+Reference equivalent: the service wiring in ``BackgroundThreadLoop``
+(``horovod/common/operations.cc:328-528``) — timeline setup at
+``operations.cc:388-395``, stall inspector, controller initialization.
+On TPU these attach as host threads/objects; there is no per-cycle
+communication loop for the compiled path.
+"""
+
+import logging
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def start(state):
+    cfg = state.config
+    if cfg.timeline and cfg.rank == 0:
+        from horovod_tpu.utils.timeline import Timeline
+        state.timeline = Timeline(cfg.timeline,
+                                  mark_cycles=cfg.timeline_mark_cycles)
+        logger.info("timeline enabled -> %s", cfg.timeline)
+    if cfg.controller_addr and cfg.size > 1:
+        from horovod_tpu.runtime.controller import ControllerClient
+        state.controller = ControllerClient(
+            cfg.controller_addr, cfg.controller_port, cfg.rank, cfg.size)
+        state.controller.connect()
+    if not cfg.stall_check_disable and state.controller is not None:
+        from horovod_tpu.runtime.stall import StallInspector
+        state.stall_inspector = StallInspector(
+            warning_time=cfg.stall_warning_time,
+            shutdown_time=cfg.stall_shutdown_time)
+        state.stall_inspector.start()
+
+
+def stop(state):
+    if state.stall_inspector is not None:
+        state.stall_inspector.stop()
+        state.stall_inspector = None
+    if state.controller is not None:
+        state.controller.close()
+        state.controller = None
+    if state.timeline is not None:
+        state.timeline.close()
+        state.timeline = None

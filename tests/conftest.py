@@ -1,0 +1,58 @@
+"""Test harness: 8 virtual CPU devices standing in for an 8-chip TPU slice.
+
+Reference test strategy (SURVEY.md §4): everything end-to-end through the
+Python API with small world sizes. Here the "world" is a virtual 8-device
+mesh (``--xla_force_host_platform_device_count=8``), matching how the driver
+dry-runs the multi-chip path. Multi-process controller/launcher tests spawn
+real localhost processes and don't need devices at all.
+"""
+
+import os
+
+# Must happen before jax is imported anywhere.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable the axon TPU plugin hook
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize may already have forced jax_platforms=axon,cpu;
+# override it before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def n_devices():
+    import jax
+    return len(jax.devices())
+
+
+@pytest.fixture()
+def hvd():
+    """An initialized horovod_tpu with a fresh 1-D mesh."""
+    import horovod_tpu as hvd_mod
+    hvd_mod.shutdown()
+    hvd_mod.init()
+    yield hvd_mod
+    hvd_mod.shutdown()
+
+
+@pytest.fixture()
+def hvd2d():
+    """An initialized horovod_tpu with a 2-D (dcn=2, data=4) mesh."""
+    import horovod_tpu as hvd_mod
+    hvd_mod.shutdown()
+    hvd_mod.init(num_slices=2)
+    yield hvd_mod
+    hvd_mod.shutdown()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,108 @@
+"""Adasum numerics against an independent NumPy reference.
+
+Reference pattern: test/test_adasum_tensorflow.py:33-63 — reimplement the
+pairwise formula + log2(n) tree in NumPy, run the distributed op, compare.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops import adasum, collective
+
+
+def reference_combine(a, b):
+    af, bf = a.astype(np.float64).ravel(), b.astype(np.float64).ravel()
+    dot = np.dot(af, bf)
+    na2, nb2 = np.dot(af, af), np.dot(bf, bf)
+    ca = 1.0 - dot / (2 * na2) if na2 > 0 else 1.0
+    cb = 1.0 - dot / (2 * nb2) if nb2 > 0 else 1.0
+    return (af * ca + bf * cb).reshape(a.shape)
+
+
+def test_pairwise_combine_orthogonal(rng):
+    # Orthogonal gradients: dot = 0 -> plain sum.
+    a = np.array([1.0, 0.0], np.float32)
+    b = np.array([0.0, 1.0], np.float32)
+    out = np.asarray(adasum.adasum_combine(jnp.array(a), jnp.array(b)))
+    np.testing.assert_allclose(out, [1.0, 1.0])
+
+
+def test_pairwise_combine_identical():
+    # Identical gradients: dot = |a|^2 = |b|^2 -> each scaled by 1/2 -> a.
+    a = np.array([2.0, -3.0, 1.0], np.float32)
+    out = np.asarray(adasum.adasum_combine(jnp.array(a), jnp.array(a)))
+    np.testing.assert_allclose(out, a, rtol=1e-6)
+
+
+def test_pairwise_combine_random_matches_numpy(rng):
+    a = rng.standard_normal(37).astype(np.float32)
+    b = rng.standard_normal(37).astype(np.float32)
+    out = np.asarray(adasum.adasum_combine(jnp.array(a), jnp.array(b)))
+    np.testing.assert_allclose(out, reference_combine(a, b), rtol=1e-5)
+
+
+def test_pairwise_combine_zero_norm():
+    a = np.zeros((4,), np.float32)
+    b = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    out = np.asarray(adasum.adasum_combine(jnp.array(a), jnp.array(b)))
+    np.testing.assert_allclose(out, b)
+
+
+def test_numpy_tree_schedule_properties(rng):
+    vecs = [rng.standard_normal(16).astype(np.float32) for _ in range(4)]
+    out = adasum.adasum_tree_np(vecs)
+    assert out.shape == (16,)
+    # All ranks converge to the same result by symmetry of the schedule.
+    # (adasum_tree_np returns rank 0's value; recompute at "rank 2" by
+    # re-running — the schedule is deterministic.)
+
+
+def test_distributed_adasum_matches_numpy_tree(hvd, n_devices, rng):
+    vals = rng.standard_normal((n_devices, 33)).astype(np.float32)
+    expected = adasum.adasum_tree_np([vals[i] for i in range(n_devices)])
+
+    def f():
+        r = collective.mesh_rank()
+        x = jnp.asarray(vals)[r]
+        return adasum.adasum_allreduce(x, ("data",))
+
+    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=(), out_specs=P(),
+                        check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_distributed_adasum_via_allreduce_op(hvd, n_devices, rng):
+    import horovod_tpu as hvd_api
+    vals = rng.standard_normal((n_devices, 8)).astype(np.float32)
+    expected = adasum.adasum_tree_np([vals[i] for i in range(n_devices)])
+
+    def f():
+        x = jnp.asarray(vals)[collective.mesh_rank()]
+        return collective.allreduce(x, op=hvd_api.Adasum)
+
+    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=(), out_specs=P(),
+                        check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_hierarchical_adasum_2d(hvd2d, n_devices, rng):
+    """2-D mesh: average within slice ('data'), Adasum across slices
+    ('dcn') — the adasum_cuda_operations.cc structure."""
+    data_size = n_devices // 2
+    vals = rng.standard_normal((n_devices, 12)).astype(np.float32)
+    grid = vals.reshape(2, data_size, 12)
+    slice_means = grid.mean(axis=1)
+    expected = adasum.adasum_tree_np([slice_means[0], slice_means[1]])
+
+    def f():
+        x = jnp.asarray(vals)[collective.mesh_rank()]
+        return adasum.adasum_allreduce(x, ("dcn", "data"))
+
+    out = jax.shard_map(f, mesh=hvd2d.mesh(), in_specs=(), out_specs=P(),
+                        check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-5)

@@ -1,0 +1,83 @@
+"""Lifecycle + identity tests (reference pattern: test/test_common.py and
+the rank/size checks at the top of test/test_tensorflow.py)."""
+
+import pytest
+
+
+def test_init_idempotent(hvd):
+    hvd.init()
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_single_process_identity(hvd):
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+
+
+def test_mesh_shape(hvd, n_devices):
+    assert hvd.num_devices() == n_devices
+    assert hvd.mesh().axis_names == ("data",)
+    assert hvd.data_axes() == ("data",)
+
+
+def test_mesh_2d(hvd2d, n_devices):
+    m = hvd2d.mesh()
+    assert m.axis_names == ("dcn", "data")
+    assert m.devices.shape == (2, n_devices // 2)
+    assert hvd2d.data_axes() == ("dcn", "data")
+
+
+def test_uninitialized_raises():
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    with pytest.raises(RuntimeError):
+        hvd.rank()
+    with pytest.raises(RuntimeError):
+        hvd.mesh()
+
+
+def test_env_contract(monkeypatch):
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HOROVOD_SIZE", "8")
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", "1")
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", "4")
+    monkeypatch.setenv("HOROVOD_CROSS_RANK", "1")
+    monkeypatch.setenv("HOROVOD_CROSS_SIZE", "2")
+    # No coordinator addr -> stays single-process JAX but identity comes
+    # from the env contract (what the launcher guarantees).
+    hvd.init()
+    try:
+        assert hvd.rank() == 3
+        assert hvd.size() == 8
+        assert hvd.local_rank() == 1
+        assert hvd.local_size() == 4
+        assert hvd.cross_rank() == 1
+        assert hvd.cross_size() == 2
+        # cross_size=2 -> hierarchical 2-D mesh
+        assert hvd.mesh().axis_names == ("dcn", "data")
+    finally:
+        hvd.shutdown()
+
+
+def test_config_knobs(monkeypatch):
+    from horovod_tpu.config import Config
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1048576")
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "3.5")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "30")
+    cfg = Config.from_env()
+    assert cfg.fusion_threshold == 1048576
+    assert cfg.cycle_time_ms == 3.5
+    assert cfg.hierarchical_allreduce is True
+    assert cfg.stall_warning_time == 30.0
+
+
+def test_mpi_threads_supported(hvd):
+    assert hvd.mpi_threads_supported() is False

@@ -1,0 +1,132 @@
+"""Fusion-buffer tests (reference semantics: controller.cc:639-769
+FuseResponses + fused allreduce value checks in test_tensorflow.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_api
+from horovod_tpu.ops import collective, fusion
+
+
+def test_plan_buckets_groups_by_dtype():
+    leaves = [np.ones((4,), np.float32), np.ones((2,), np.int32),
+              np.ones((8,), np.float32)]
+    buckets = fusion.plan_buckets(leaves, threshold_bytes=1 << 20)
+    dtypes = sorted(str(b.dtype) for b in buckets)
+    assert dtypes == ["float32", "int32"]
+    f32 = next(b for b in buckets if str(b.dtype) == "float32")
+    assert f32.leaf_indices == (0, 2)
+    assert f32.sizes == (4, 8)
+
+
+def test_plan_buckets_respects_threshold():
+    leaves = [np.ones((100,), np.float32) for _ in range(10)]  # 400 B each
+    buckets = fusion.plan_buckets(leaves, threshold_bytes=1000)
+    assert len(buckets) == 5  # 2 leaves per 1000-B bucket
+    # a single oversized leaf still gets a bucket
+    big = [np.ones((1000,), np.float32)]
+    assert len(fusion.plan_buckets(big, threshold_bytes=100)) == 1
+
+
+def test_fused_allreduce_matches_unfused(hvd, n_devices):
+    tree_shapes = {"w": (3, 4), "b": (4,), "scale": ()}
+
+    def f():
+        r = collective.mesh_rank().astype(jnp.float32)
+        tree = {k: (r + 1) * jnp.ones(s) for k, s in tree_shapes.items()}
+        fused = fusion.fused_allreduce(tree, op=hvd_api.Average)
+        unfused = jax.tree_util.tree_map(
+            lambda x: collective.allreduce(x, op=hvd_api.Average), tree)
+        return fused, unfused
+
+    specs = {k: P() for k in tree_shapes}
+    fused, unfused = jax.shard_map(
+        f, mesh=hvd.mesh(), in_specs=(),
+        out_specs=(specs, specs), check_vma=False)()
+    for k in tree_shapes:
+        np.testing.assert_allclose(fused[k], unfused[k], rtol=1e-6)
+        expected = np.mean(np.arange(1, n_devices + 1))
+        np.testing.assert_allclose(fused[k], expected * np.ones(
+            tree_shapes[k]), rtol=1e-6)
+
+
+def test_fused_allreduce_mixed_dtypes(hvd, n_devices):
+    def f():
+        r = collective.mesh_rank()
+        tree = {"f32": (r + 1).astype(jnp.float32) * jnp.ones((5,)),
+                "bf16": (r + 1).astype(jnp.bfloat16) * jnp.ones(
+                    (7,), jnp.bfloat16)}
+        return fusion.fused_allreduce(tree, op=hvd_api.Sum)
+
+    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=(),
+                        out_specs={"f32": P(), "bf16": P()},
+                        check_vma=False)()
+    total = sum(range(1, n_devices + 1))
+    np.testing.assert_allclose(out["f32"], total * np.ones((5,)))
+    assert out["bf16"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out["bf16"], np.float32),
+                               total * np.ones((7,)), rtol=1e-1)
+
+
+def test_fused_allreduce_tiny_threshold_still_correct(hvd, n_devices):
+    """Many buckets (threshold smaller than single leaves) == same values."""
+
+    def f():
+        r = collective.mesh_rank().astype(jnp.float32)
+        tree = [r * jnp.ones((16,)) + i for i in range(6)]
+        return fusion.fused_allreduce(tree, op=hvd_api.Average,
+                                      threshold_bytes=8)
+
+    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=(),
+                        out_specs=[P()] * 6, check_vma=False)()
+    mean_r = np.mean(np.arange(n_devices))
+    for i in range(6):
+        np.testing.assert_allclose(out[i], mean_r + i, rtol=1e-6)
+
+
+def test_fused_allreduce_compressed(hvd, n_devices):
+    def f():
+        r = collective.mesh_rank().astype(jnp.float32)
+        tree = {"a": (r + 1) * jnp.ones((4,)), "b": (r + 1) * jnp.ones((2,))}
+        return fusion.fused_allreduce(tree, op=hvd_api.Average,
+                                      compression=hvd_api.Compression.fp16)
+
+    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=(),
+                        out_specs={"a": P(), "b": P()}, check_vma=False)()
+    expected = np.mean(np.arange(1, n_devices + 1))
+    np.testing.assert_allclose(out["a"], expected, rtol=1e-2)
+    assert out["a"].dtype == jnp.float32
+
+
+def test_fused_allreduce_hierarchical_on_2d_mesh(hvd2d, n_devices):
+    def f():
+        r = collective.mesh_rank().astype(jnp.float32)
+        tree = {"w": (r + 1) * jnp.ones((9,))}
+        return fusion.fused_allreduce(tree, op=hvd_api.Average,
+                                      hierarchical=True)
+
+    out = jax.shard_map(f, mesh=hvd2d.mesh(), in_specs=(),
+                        out_specs={"w": P()}, check_vma=False)()
+    expected = np.mean(np.arange(1, n_devices + 1))
+    np.testing.assert_allclose(out["w"], expected * np.ones((9,)), rtol=1e-6)
+
+
+def test_fused_allreduce_empty_tree(hvd):
+    assert fusion.fused_allreduce({}) == {}
+
+
+def test_one_collective_per_bucket(hvd):
+    """The fused path must emit exactly one all-reduce per dtype bucket
+    (the whole point of fusion — reference fuses to one NCCL call per
+    cycle, nccl_operations.cc:55-105)."""
+
+    def f():
+        tree = [jnp.ones((8,)) * i for i in range(10)]
+        return fusion.fused_allreduce(tree, op=hvd_api.Sum)
+
+    fn = jax.jit(jax.shard_map(f, mesh=hvd.mesh(), in_specs=(),
+                               out_specs=[P()] * 10, check_vma=False))
+    hlo = fn.lower().compile().as_text()
+    assert hlo.count("all-reduce") <= 2  # one bucket (plus possible fusion)
