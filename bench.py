@@ -1,0 +1,87 @@
+"""Headline benchmark: synthetic ResNet img/sec through the full framework
+hot path (DistributedOptimizer -> fused allreduce -> optimizer update,
+compiled over the global mesh).
+
+The TPU analogue of the reference's synthetic benchmarks
+(``/root/reference/examples/pytorch_synthetic_benchmark.py``: timed batches
+after warmup, img/sec) and of ``tf_cnn_benchmarks`` as used for the
+published numbers (``docs/benchmarks.rst:16-42``).
+
+Baseline for ``vs_baseline``: the reference's documented sample output —
+ResNet-101, batch 64/GPU, 16 Pascal GPUs: "total images/sec: 1656.82"
+(``docs/benchmarks.rst:28-42``), i.e. **103.55 img/s per chip**. We run the
+same workload (ResNet-101, batch 64 per chip, synthetic data) per TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+# reference docs/benchmarks.rst:28-42 — 1656.82 img/s over 16 Pascal GPUs
+BASELINE_IMG_PER_SEC_PER_CHIP = 1656.82 / 16
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet101",
+                        choices=["resnet50", "resnet101", "vgg16"])
+    parser.add_argument("--batch-size", type=int, default=64,
+                        help="per-chip batch size (reference uses 64)")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-warmup", type=int, default=3)
+    parser.add_argument("--num-iters", type=int, default=20)
+    args = parser.parse_args()
+
+    import horovod_tpu as hvd
+    from horovod_tpu import models, training
+
+    hvd.init()
+    ndev = hvd.num_devices()
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+
+    model_cls = {"resnet50": models.ResNet50, "resnet101": models.ResNet101,
+                 "vgg16": models.VGG16}[args.model]
+    model = model_cls(num_classes=1000, dtype=dtype)
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    global_batch = args.batch_size * ndev
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal(
+        (global_batch, args.image_size, args.image_size, 3)), dtype)
+    labels = jnp.asarray(rng.integers(0, 1000, size=(global_batch,)),
+                         jnp.int32)
+
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        images[:1])
+    step = training.make_train_step(model, tx, donate=True)
+
+    for _ in range(args.num_warmup):
+        state, loss = step(state, images, labels)
+        jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        state, loss = step(state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = global_batch * args.num_iters / dt
+    per_chip = img_per_sec / ndev
+    print(json.dumps({
+        "metric": f"{args.model}_synthetic_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
