@@ -1,0 +1,111 @@
+// Common types for the native host-side core.
+//
+// TPU-native rebuild of the reference's horovod/common/common.h:104-260
+// (Status, DataType, TensorShape) — re-designed, not translated: no
+// framework Tensor virtual interface (the TPU data plane is compiled by
+// XLA; this core only ever owns host CPU buffers), no CUDA events.
+#ifndef HVD_COMMON_H
+#define HVD_COMMON_H
+
+#include <cstdint>
+#include <numeric>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+enum class StatusType : uint8_t {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+class Status {
+ public:
+  Status() = default;
+  static Status OK() { return Status(); }
+  static Status Error(StatusType t, std::string msg) {
+    Status s; s.type_ = t; s.reason_ = std::move(msg); return s;
+  }
+  static Status Unknown(std::string msg) {
+    return Error(StatusType::UNKNOWN_ERROR, std::move(msg));
+  }
+  static Status Precondition(std::string msg) {
+    return Error(StatusType::PRECONDITION_ERROR, std::move(msg));
+  }
+  static Status Aborted(std::string msg) {
+    return Error(StatusType::ABORTED, std::move(msg));
+  }
+  static Status InvalidArgument(std::string msg) {
+    return Error(StatusType::INVALID_ARGUMENT, std::move(msg));
+  }
+  static Status InProgress() {
+    Status s; s.type_ = StatusType::IN_PROGRESS; return s;
+  }
+  bool ok() const { return type_ == StatusType::OK; }
+  bool in_progress() const { return type_ == StatusType::IN_PROGRESS; }
+  StatusType type() const { return type_; }
+  const std::string& reason() const { return reason_; }
+
+ private:
+  StatusType type_ = StatusType::OK;
+  std::string reason_;
+};
+
+// Wire dtypes (reference: message.h:27-39, 11 dtypes). BFLOAT16 added —
+// it is the TPU wire format of choice.
+enum class DataType : uint8_t {
+  UINT8 = 0, INT8 = 1, UINT16 = 2, INT16 = 3,
+  INT32 = 4, INT64 = 5, FLOAT16 = 6, FLOAT32 = 7,
+  FLOAT64 = 8, BOOL = 9, BFLOAT16 = 10,
+};
+
+inline size_t DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8: case DataType::INT8: case DataType::BOOL:
+      return 1;
+    case DataType::UINT16: case DataType::INT16: case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      return 2;
+    case DataType::INT32: case DataType::FLOAT32:
+      return 4;
+    case DataType::INT64: case DataType::FLOAT64:
+      return 8;
+  }
+  return 0;
+}
+
+const char* DataTypeName(DataType dt);
+
+class TensorShape {
+ public:
+  TensorShape() = default;
+  explicit TensorShape(std::vector<int64_t> dims) : dims_(std::move(dims)) {}
+  void AddDim(int64_t d) { dims_.push_back(d); }
+  int ndim() const { return static_cast<int>(dims_.size()); }
+  int64_t dim(int i) const { return dims_[i]; }
+  const std::vector<int64_t>& dims() const { return dims_; }
+  int64_t num_elements() const {
+    return std::accumulate(dims_.begin(), dims_.end(), int64_t{1},
+                           std::multiplies<int64_t>());
+  }
+  bool operator==(const TensorShape& o) const { return dims_ == o.dims_; }
+  bool operator!=(const TensorShape& o) const { return dims_ != o.dims_; }
+  std::string DebugString() const;
+
+ private:
+  std::vector<int64_t> dims_;
+};
+
+// Env helpers (reference: utils/env_parser.cc).
+int64_t EnvInt(const char* name, int64_t dflt);
+double EnvDouble(const char* name, double dflt);
+std::string EnvStr(const char* name, const std::string& dflt);
+bool EnvBool(const char* name, bool dflt);
+
+}  // namespace hvd
+
+#endif  // HVD_COMMON_H

@@ -1,0 +1,77 @@
+// Host control plane: coordinator/worker negotiation over TCP.
+//
+// Role of the reference's abstract Controller (horovod/common/controller.h:
+// 42-56) + its MPI/Gloo implementations (mpi_controller.cc,
+// gloo_controller.cc): gather ready-tensor announcements to rank 0, let it
+// decide what to execute, broadcast the decision, plus small-payload
+// bcast/barrier/bit-allreduce used by the response cache and autotuner.
+// Transport is plain TCP in a star (TPU VMs have no MPI); the bulk tensor
+// path never goes through here.
+#ifndef HVD_CONTROLLER_H
+#define HVD_CONTROLLER_H
+
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "hvd/message.h"
+#include "hvd/socket.h"
+
+namespace hvd {
+
+struct PeerInfo {
+  std::string host;
+  int data_port = 0;  // PeerMesh server port for bulk tensor traffic
+};
+
+class ControlPlane {
+ public:
+  // rank 0 listens on control_port; others connect to coord_host.
+  ControlPlane(int rank, int size, std::string coord_host, int control_port);
+  ~ControlPlane();
+
+  // Exchange hellos; returns the full roster (host + data port per rank).
+  // advertise_* describe this rank's PeerMesh endpoint.
+  Status Initialize(const std::string& advertise_host, int advertise_port,
+                    std::vector<PeerInfo>& roster);
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  bool is_coordinator() const { return rank_ == 0; }
+
+  // --- synchronous round primitives (reference controller.h:44-56) ---
+  // Worker side of a negotiation round: send requests, receive decision.
+  Status SendReadyTensors(const RequestList& reqs);
+  Status RecvFinalTensors(ResponseList& resp);
+  // Coordinator side: receive all workers' requests, send the decision.
+  Status RecvReadyTensors(std::vector<RequestList>& per_rank);
+  Status SendFinalTensors(const ResponseList& resp);
+
+  // Broadcast raw bytes from root to all (autotune params, roster, ...).
+  Status Bcast(std::vector<uint8_t>& bytes, int root);
+  Status Barrier();
+  // Bitwise AND/OR allreduce over a packed bitvector (response cache sync,
+  // reference controller.h:47-49 CrossRankBitwiseAnd/Or).
+  Status BitAllreduce(std::vector<uint64_t>& bits, bool is_and);
+
+ private:
+  Status EnsureConnected();
+  // gather variable-size frames from all ranks to rank 0
+  Status GatherFrames(const std::vector<uint8_t>& mine,
+                      std::vector<std::vector<uint8_t>>& all);
+  Status BcastFrame(std::vector<uint8_t>& bytes, int root);
+
+  int rank_;
+  int size_;
+  std::string coord_host_;
+  int control_port_;
+  std::unique_ptr<TcpServer> server_;                 // coordinator only
+  std::vector<std::unique_ptr<TcpConnection>> workers_;  // coordinator only
+  std::unique_ptr<TcpConnection> coord_;              // workers only
+  std::mutex mu_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_CONTROLLER_H

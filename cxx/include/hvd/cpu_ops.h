@@ -1,0 +1,60 @@
+// CPU collective implementations over the TCP peer mesh.
+//
+// Role of the reference's gloo/MPI op set (horovod/common/ops/
+// gloo_operations.cc, mpi_operations.cc): the host data plane used by the
+// eager API and the torch adapter when tensors live on host. TPU-resident
+// data never comes through here — XLA emits those collectives
+// (horovod_tpu/ops/collective.py).
+//
+// Allreduce is ring-based (bandwidth-optimal: 2(N-1)/N bytes per link),
+// allgatherv is a ring rotation, broadcast is a star from root, Adasum is
+// the recursive vector-halving distance-doubling algorithm with fp32
+// dot/norm accumulation (reference: ops/adasum/adasum.h:186-330).
+#ifndef HVD_CPU_OPS_H
+#define HVD_CPU_OPS_H
+
+#include <cstdint>
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/peer_mesh.h"
+
+namespace hvd {
+
+enum class ReduceOp : uint8_t { SUM = 0, AVERAGE = 1, MIN = 2, MAX = 3,
+                                ADASUM = 4 };
+
+// In-place elementwise reduce: acc[i] = op(acc[i], other[i]).
+void ReduceInto(void* acc, const void* other, int64_t count, DataType dtype,
+                ReduceOp op);
+
+// In-place scale: data[i] *= factor (float types only; no-op otherwise).
+void ScaleInPlace(void* data, int64_t count, DataType dtype, double factor);
+
+// In-place ring allreduce over all ranks. AVERAGE divides by size at the
+// end. count may be any value (chunks may be empty for tiny tensors).
+Status RingAllreduce(PeerMesh& mesh, int rank, int size, void* data,
+                     int64_t count, DataType dtype, ReduceOp op);
+
+// Variable-size allgather: rank r contributes counts[r] elements; output
+// holds the concatenation in rank order (reference MPI_Allgatherv).
+Status RingAllgatherv(PeerMesh& mesh, int rank, int size, const void* input,
+                      const std::vector<int64_t>& counts, DataType dtype,
+                      void* output);
+
+// Star broadcast from root (in-place on non-roots).
+Status Broadcast(PeerMesh& mesh, int rank, int size, void* data,
+                 int64_t count, DataType dtype, int root);
+
+// Pairwise-exchange all-to-all: input/output are size*block elements.
+Status AllToAll(PeerMesh& mesh, int rank, int size, const void* input,
+                int64_t block, DataType dtype, void* output);
+
+// Adasum allreduce (power-of-2 size required, like the reference).
+// Float dtypes only; dot/norm accumulation in fp64.
+Status AdasumAllreduce(PeerMesh& mesh, ControlPlane& control, int rank,
+                       int size, void* data, int64_t count, DataType dtype);
+
+}  // namespace hvd
+
+#endif  // HVD_CPU_OPS_H

@@ -1,0 +1,139 @@
+// Control-plane messages and their wire format.
+//
+// Role of the reference's horovod/common/message.h:46-221 (Request /
+// Response / RequestList / ResponseList) — but serialized with a small
+// hand-rolled length-prefixed binary codec instead of FlatBuffers (zero
+// third-party deps; messages are tiny and host-side only).
+#ifndef HVD_MESSAGE_H
+#define HVD_MESSAGE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "hvd/common.h"
+
+namespace hvd {
+
+// Binary writer/reader for the wire format. All integers little-endian.
+class Writer {
+ public:
+  void u8(uint8_t v) { buf_.push_back(v); }
+  void i32(int32_t v) { append(&v, 4); }
+  void i64(int64_t v) { append(&v, 8); }
+  void str(const std::string& s) {
+    i32(static_cast<int32_t>(s.size()));
+    buf_.insert(buf_.end(), s.begin(), s.end());
+  }
+  void bytes(const std::vector<uint8_t>& b) {
+    i32(static_cast<int32_t>(b.size()));
+    buf_.insert(buf_.end(), b.begin(), b.end());
+  }
+  const std::vector<uint8_t>& data() const { return buf_; }
+  std::vector<uint8_t> take() { return std::move(buf_); }
+
+ private:
+  void append(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf_.insert(buf_.end(), b, b + n);
+  }
+  std::vector<uint8_t> buf_;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+  explicit Reader(const std::vector<uint8_t>& b)
+      : Reader(b.data(), b.size()) {}
+  uint8_t u8() { return *p_++; }
+  int32_t i32() { int32_t v; copy(&v, 4); return v; }
+  int64_t i64() { int64_t v; copy(&v, 8); return v; }
+  std::string str() {
+    int32_t n = i32();
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+    return s;
+  }
+  std::vector<uint8_t> bytes() {
+    int32_t n = i32();
+    std::vector<uint8_t> b(p_, p_ + n);
+    p_ += n;
+    return b;
+  }
+  bool done() const { return p_ >= end_; }
+
+ private:
+  void copy(void* dst, size_t n) {
+    std::copy(p_, p_ + n, static_cast<uint8_t*>(dst));
+    p_ += n;
+  }
+  const uint8_t* p_;
+  const uint8_t* end_;
+};
+
+// A worker's announcement that a tensor is ready (reference:
+// message.h:46-99).
+struct Request {
+  enum Type : uint8_t {
+    ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2, JOIN = 3, ADASUM = 4,
+    ALLTOALL = 5, REDUCESCATTER = 6, BARRIER = 7,
+  };
+  Type type = ALLREDUCE;
+  int32_t request_rank = 0;
+  DataType dtype = DataType::FLOAT32;
+  std::string tensor_name;
+  int32_t root_rank = -1;
+  TensorShape shape;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  uint8_t reduce_op = 0;  // ReduceOp; must agree across ranks
+
+  void Serialize(Writer& w) const;
+  static Request Deserialize(Reader& r);
+};
+
+const char* RequestTypeName(Request::Type t);
+
+// The coordinator's instruction of what to execute (reference:
+// message.h:131-191). A fused response carries several tensor names.
+struct Response {
+  enum Type : uint8_t {
+    ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2, JOIN = 3, ADASUM = 4,
+    ALLTOALL = 5, REDUCESCATTER = 6, BARRIER = 7, ERROR = 8,
+  };
+  Type type = ALLREDUCE;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  // ALLREDUCE/ADASUM: per-tensor element counts (zero-fill for joined
+  // ranks + fusion planning). ALLGATHER: per-rank first-dim sizes
+  // (reference tensor_sizes).
+  std::vector<int64_t> tensor_sizes;
+  DataType dtype = DataType::FLOAT32;
+  uint8_t reduce_op = 0;  // ReduceOp for ALLREDUCE responses
+  // ranks contributing real data (size - joined); the AVERAGE divisor must
+  // be identical on every rank, so the coordinator pins it here
+  int32_t active_ranks = 0;
+
+  void Serialize(Writer& w) const;
+  static Response Deserialize(Reader& r);
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+
+  std::vector<uint8_t> Serialize() const;
+  static RequestList Deserialize(const std::vector<uint8_t>& buf);
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+
+  std::vector<uint8_t> Serialize() const;
+  static ResponseList Deserialize(const std::vector<uint8_t>& buf);
+};
+
+}  // namespace hvd
+
+#endif  // HVD_MESSAGE_H

@@ -1,0 +1,58 @@
+// Coordinator-side negotiation: readiness counting, validation, fusion.
+//
+// Role of the reference's Controller::ComputeResponseList internals
+// (horovod/common/controller.cc:55-346): IncrementTensorCount until every
+// non-joined rank announced a tensor, validate cross-rank agreement
+// (shape/dtype/op), then fuse compatible responses up to the fusion
+// threshold (FuseResponses, controller.cc:639-769).
+#ifndef HVD_NEGOTIATOR_H
+#define HVD_NEGOTIATOR_H
+
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/message.h"
+
+namespace hvd {
+
+class Negotiator {
+ public:
+  explicit Negotiator(int size) : size_(size) {}
+
+  // Feed one rank's announcements for this cycle. Returns the names that
+  // just became ready (announced by all size - joined ranks).
+  std::vector<std::string> AddRequests(const std::vector<Request>& reqs,
+                                       int joined_count);
+  // After joined_count changes (a rank joined), re-check readiness of
+  // everything pending.
+  std::vector<std::string> ReadyAfterJoin(int joined_count);
+
+  // Build the (validated, possibly error) response for a ready tensor and
+  // clear its state.
+  Response BuildResponse(const std::string& name);
+
+  // Fuse compatible responses: same type, same dtype, no errors,
+  // cumulative payload <= threshold bytes. Allreduce/Adasum only —
+  // allgather/broadcast go out one-per-tensor. Order preserved with
+  // look-ahead (a too-big tensor doesn't block later small ones from
+  // fusing, reference controller.cc:687-696).
+  static std::vector<Response> Fuse(std::vector<Response> responses,
+                                    int64_t threshold_bytes);
+
+  // Names currently waiting (for the stall inspector): name -> ranks that
+  // have announced it.
+  std::vector<std::pair<std::string, std::vector<int>>> Pending() const;
+
+  bool has_pending() const { return !message_table_.empty(); }
+
+ private:
+  int size_;
+  // name -> per-rank requests received so far (reference message_table_)
+  std::unordered_map<std::string, std::vector<Request>> message_table_;
+  std::vector<std::string> arrival_order_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NEGOTIATOR_H

@@ -1,0 +1,49 @@
+// Global state, background negotiation/execution loop, and the C API.
+//
+// Role of the reference's horovod/common/operations.cc: the singleton
+// HorovodGlobalState, InitializeHorovodOnce spawning the background
+// thread, RunLoopOnce per-cycle negotiation + execution, and the
+// extern "C" surface Python binds via ctypes (operations.cc:641-778 and
+// the Enqueue* functions 782-931).
+//
+// TPU adaptation: this core is the HOST data plane (eager numpy/torch
+// tensors, control utilities, Join) — collectives on TPU-resident arrays
+// are compiled by XLA and never enter this queue.
+#ifndef HVD_OPERATIONS_H
+#define HVD_OPERATIONS_H
+
+#include <cstdint>
+
+extern "C" {
+
+// Lifecycle. Returns 0 on success.
+int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
+              const char* advertise_host);
+int hvdc_shutdown();
+int hvdc_is_initialized();
+int hvdc_rank();
+int hvdc_size();
+
+// Enqueue a collective; returns a handle (>=0) or -1 on immediate error
+// (error text via hvdc_last_error). `type` is Request::Type, `op` is
+// ReduceOp, `dtype` is DataType.
+int hvdc_enqueue(int type, const char* name, const void* data,
+                 const int64_t* shape, int ndim, int dtype, int op,
+                 int root_rank, double prescale, double postscale);
+int hvdc_enqueue_join();
+
+// 0 = pending, 1 = done ok, -1 = done with error.
+int hvdc_poll(int handle);
+int hvdc_wait(int handle);
+const char* hvdc_error_message(int handle);
+const char* hvdc_last_error();
+int64_t hvdc_output_size(int handle);
+int hvdc_copy_output(int handle, void* dst);
+void hvdc_release(int handle);
+
+// Convenience: negotiated barrier across all ranks (blocking).
+int hvdc_barrier();
+
+}  // extern "C"
+
+#endif  // HVD_OPERATIONS_H

@@ -1,0 +1,78 @@
+// Bulk data-plane p2p connections between ranks.
+//
+// Role of the vendored gloo full-mesh TCP transport in the reference
+// (horovod/common/gloo/gloo_context.cc:30-56 full-mesh rendezvous;
+// gloo_operations.cc collectives ride it). Connections are lazy: the lower
+// rank initiates, the higher rank accepts (a background accept thread
+// registers inbound peers). All transfers go through a poll()-based
+// progress engine so simultaneous send/recv pairs (ring steps, pairwise
+// exchanges) cannot deadlock on full TCP buffers — the role MPI_Sendrecv
+// plays in the reference's Adasum path (adasum_mpi.cc).
+#ifndef HVD_PEER_MESH_H
+#define HVD_PEER_MESH_H
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "hvd/controller.h"
+#include "hvd/socket.h"
+
+namespace hvd {
+
+// One pending raw transfer for the progress engine.
+struct Transfer {
+  int fd = -1;
+  bool is_send = false;
+  const uint8_t* send_buf = nullptr;
+  uint8_t* recv_buf = nullptr;
+  size_t len = 0;
+  size_t done = 0;
+};
+
+// Drive all transfers to completion concurrently (poll loop).
+Status Progress(std::vector<Transfer>& transfers);
+
+class PeerMesh {
+ public:
+  PeerMesh(int rank, int size);
+  ~PeerMesh();
+
+  Status Start();             // bind server + start accept thread
+  int port() const;
+  void SetRoster(std::vector<PeerInfo> roster);
+
+  // Get (or establish) the duplex connection to peer.
+  Status Get(int peer, TcpConnection** out);
+
+  // Blocking helpers (all full-duplex-safe via Progress).
+  Status SendTo(int peer, const void* data, size_t len);
+  Status RecvFrom(int peer, void* data, size_t len);
+  Status SendRecv(int peer, const void* send, size_t send_len, void* recv,
+                  size_t recv_len);
+  // Simultaneous ring step: send to `next`, receive from `prev`.
+  Status RingStep(int next, int prev, const void* send, size_t send_len,
+                  void* recv, size_t recv_len);
+
+  void Shutdown();
+
+ private:
+  void AcceptLoop();
+
+  int rank_;
+  int size_;
+  std::unique_ptr<TcpServer> server_;
+  std::vector<PeerInfo> roster_;
+  std::map<int, std::unique_ptr<TcpConnection>> conns_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::thread accept_thread_;
+  bool shutdown_ = false;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_PEER_MESH_H

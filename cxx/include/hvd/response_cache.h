@@ -1,0 +1,65 @@
+// LRU cache of negotiated responses for steady-state cycles.
+//
+// Role of the reference's horovod/common/response_cache.{h,cc}: once a
+// tensor's response has been negotiated, subsequent cycles skip the full
+// request payload — ranks announce cache hits as a packed bitvector, the
+// coordinator syncs bits with a bitwise-AND allreduce, and tensors whose
+// bit survives on every rank proceed straight to execution
+// (CacheCoordinator::sync, response_cache.h:107-167).
+#ifndef HVD_RESPONSE_CACHE_H
+#define HVD_RESPONSE_CACHE_H
+
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/message.h"
+
+namespace hvd {
+
+class ResponseCache {
+ public:
+  enum class CacheState { MISS = 0, HIT = 1, INVALID = 2 };
+
+  explicit ResponseCache(size_t capacity = 1024) : capacity_(capacity) {}
+
+  // HIT when a response for this name is cached with identical parameters;
+  // INVALID when cached with different shape/dtype/op (must renegotiate
+  // and evict).
+  CacheState Cached(const Request& req) const;
+  void Put(const Request& req, const Response& resp);
+  const Response& Get(const std::string& name);
+  uint32_t GetBit(const std::string& name) const;
+  void Erase(const std::string& name);
+  size_t size() const { return entries_.size(); }
+  size_t capacity() const { return capacity_; }
+
+  // All cached responses whose bit is set in `bits`, in bit order.
+  std::vector<Response> ResponsesForBits(
+      const std::vector<uint64_t>& bits) const;
+  // Pack the hit-bits for a set of names.
+  std::vector<uint64_t> PackBits(const std::vector<std::string>& names) const;
+  size_t NumBitWords() const { return (capacity_ + 63) / 64; }
+
+ private:
+  struct Entry {
+    Response response;
+    Request params;      // for validity checking
+    uint32_t bit;        // stable bit position
+    std::list<std::string>::iterator lru_it;
+  };
+  void Touch(const std::string& name);
+
+  size_t capacity_;
+  std::unordered_map<std::string, Entry> entries_;
+  std::list<std::string> lru_;        // front = most recent
+  std::vector<uint32_t> free_bits_;   // recycled bit positions
+  uint32_t next_bit_ = 0;
+  std::unordered_map<uint32_t, std::string> bit_to_name_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_RESPONSE_CACHE_H

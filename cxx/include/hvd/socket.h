@@ -1,0 +1,67 @@
+// Minimal TCP framing layer for the host control/data planes.
+//
+// Plays the role the vendored gloo TCP transport + HTTPRequest library play
+// in the reference (horovod/common/gloo/, third_party/) — TPU VMs have no
+// MPI, so everything host-side rides plain TCP. Frames are
+// [uint32 little-endian length][payload].
+#ifndef HVD_SOCKET_H
+#define HVD_SOCKET_H
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "hvd/common.h"
+
+namespace hvd {
+
+class TcpConnection {
+ public:
+  explicit TcpConnection(int fd) : fd_(fd) {}
+  ~TcpConnection();
+  TcpConnection(const TcpConnection&) = delete;
+  TcpConnection& operator=(const TcpConnection&) = delete;
+
+  // Connect with retry (the peer may not be listening yet during startup).
+  static std::unique_ptr<TcpConnection> Connect(const std::string& host,
+                                                int port,
+                                                double timeout_sec = 60.0);
+
+  Status SendFrame(const void* data, uint32_t len);
+  Status SendFrame(const std::vector<uint8_t>& buf) {
+    return SendFrame(buf.data(), static_cast<uint32_t>(buf.size()));
+  }
+  Status RecvFrame(std::vector<uint8_t>& out);
+  // Raw (unframed) IO for bulk tensor payloads.
+  Status SendRaw(const void* data, size_t len);
+  Status RecvRaw(void* data, size_t len);
+  // Switch to non-blocking mode (required before use with the data-plane
+  // Progress engine; SendRaw/RecvRaw keep working — they poll on EAGAIN).
+  void SetNonBlocking();
+  int fd() const { return fd_; }
+
+ private:
+  int fd_;
+};
+
+class TcpServer {
+ public:
+  // Binds and listens on port (0 = ephemeral). Check port() after.
+  explicit TcpServer(int port);
+  ~TcpServer();
+  TcpServer(const TcpServer&) = delete;
+  TcpServer& operator=(const TcpServer&) = delete;
+
+  std::unique_ptr<TcpConnection> Accept(double timeout_sec = 60.0);
+  int port() const { return port_; }
+  bool ok() const { return fd_ >= 0; }
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_SOCKET_H

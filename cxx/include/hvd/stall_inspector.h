@@ -1,0 +1,44 @@
+// Coordinator-side stall watchdog.
+//
+// Role of the reference's horovod/common/stall_inspector.{h,cc}: warn when
+// a tensor has been announced by some ranks but is still missing on others
+// for longer than the warning threshold (default 60 s), listing the
+// missing ranks; optionally abort the job after a shutdown threshold
+// (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS).
+#ifndef HVD_STALL_INSPECTOR_H
+#define HVD_STALL_INSPECTOR_H
+
+#include <chrono>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace hvd {
+
+class StallInspector {
+ public:
+  StallInspector(double warn_sec = 60.0, double shutdown_sec = 0.0)
+      : warn_sec_(warn_sec), shutdown_sec_(shutdown_sec) {}
+
+  // Feed the currently-pending negotiation state
+  // (name -> ranks that have announced). Returns true if the shutdown
+  // threshold was crossed. Warnings are printed to stderr.
+  bool Check(
+      const std::vector<std::pair<std::string, std::vector<int>>>& pending,
+      int world_size);
+  // Names that have been warned about (tested directly).
+  const std::vector<std::string>& stalled() const { return stalled_; }
+
+ private:
+  double warn_sec_;
+  double shutdown_sec_;
+  std::unordered_map<std::string, std::chrono::steady_clock::time_point>
+      first_seen_;
+  std::chrono::steady_clock::time_point last_warn_{};
+  std::vector<std::string> stalled_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_STALL_INSPECTOR_H

@@ -1,0 +1,59 @@
+// Pending-tensor table + request FIFO shared between the enqueue threads
+// (Python callers) and the background cycle loop.
+//
+// Role of the reference's horovod/common/tensor_queue.{h,cc}: name-keyed
+// entries, duplicate-name rejection, drain-on-shutdown. Entries own host
+// buffers (input copied in at enqueue, output copied out at wait) — the
+// core never aliases framework memory, which keeps the Python boundary a
+// plain ctypes call.
+#ifndef HVD_TENSOR_QUEUE_H
+#define HVD_TENSOR_QUEUE_H
+
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/cpu_ops.h"
+#include "hvd/message.h"
+
+namespace hvd {
+
+struct TensorTableEntry {
+  std::string name;
+  Request::Type type = Request::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  TensorShape shape;
+  int root_rank = -1;
+  ReduceOp op = ReduceOp::SUM;
+  double prescale = 1.0, postscale = 1.0;
+  std::vector<uint8_t> data;    // input, reduced/gathered in place or grown
+  int handle = -1;
+};
+
+class TensorQueue {
+ public:
+  // Returns DUPLICATE error if `name` is already pending (reference
+  // common.h:160 DUPLICATE_NAME_ERROR).
+  Status Add(TensorTableEntry entry, const Request& req);
+  // Drain all pending requests for this cycle (reference
+  // PopMessagesFromQueue).
+  std::vector<Request> PopRequests();
+  // Remove and return the entry for a negotiated tensor.
+  bool Take(const std::string& name, TensorTableEntry& out);
+  // Names currently pending (for the stall inspector).
+  std::vector<std::string> PendingNames();
+  // Fail every pending entry (shutdown path); returns the entries so the
+  // caller can complete their handles.
+  std::vector<TensorTableEntry> DrainAll();
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+  std::deque<Request> pending_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TENSOR_QUEUE_H

@@ -1,0 +1,62 @@
+// Chrome-tracing timeline writer.
+//
+// Role of the reference's horovod/common/timeline.{h,cc}: per-tensor
+// phase events (NEGOTIATE -> op -> nested activities) written as
+// chrome://tracing JSON by a dedicated writer thread so the hot path only
+// pays an enqueue. Enabled by HOROVOD_TIMELINE=<path>.
+#ifndef HVD_TIMELINE_H
+#define HVD_TIMELINE_H
+
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace hvd {
+
+class Timeline {
+ public:
+  ~Timeline();
+  void Initialize(const std::string& path, int rank);
+  bool Initialized() const { return initialized_; }
+
+  // phase markers; category shows as the chrome trace "cat"
+  void NegotiateStart(const std::string& name, const std::string& op);
+  void NegotiateEnd(const std::string& name);
+  void Start(const std::string& name, const std::string& op);
+  void ActivityStart(const std::string& name, const std::string& activity);
+  void ActivityEnd(const std::string& name);
+  void End(const std::string& name);
+  void MarkCycle();  // HOROVOD_TIMELINE_MARK_CYCLES
+
+  void Shutdown();
+
+ private:
+  struct Event {
+    char phase;  // 'B' begin, 'E' end, 'i' instant
+    std::string tid;  // per-tensor lane
+    std::string label;
+    int64_t ts_us;
+  };
+  void Enqueue(Event e);
+  void WriterLoop();
+  int64_t NowUs() const;
+
+  bool initialized_ = false;
+  int rank_ = 0;
+  std::ofstream file_;
+  std::deque<Event> queue_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::thread writer_;
+  bool shutdown_ = false;
+  bool first_event_ = true;
+  std::chrono::steady_clock::time_point start_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TIMELINE_H

@@ -1,0 +1,211 @@
+#include "hvd/controller.h"
+
+#include <algorithm>
+
+namespace hvd {
+
+ControlPlane::ControlPlane(int rank, int size, std::string coord_host,
+                           int control_port)
+    : rank_(rank), size_(size), coord_host_(std::move(coord_host)),
+      control_port_(control_port) {}
+
+ControlPlane::~ControlPlane() = default;
+
+Status ControlPlane::EnsureConnected() {
+  if (size_ == 1) return Status::OK();
+  if (is_coordinator()) {
+    if (!server_) {
+      server_ = std::make_unique<TcpServer>(control_port_);
+      if (!server_->ok())
+        return Status::Unknown("controller: failed to listen on port " +
+                               std::to_string(control_port_));
+      workers_.resize(size_);
+      int connected = 0;
+      while (connected < size_ - 1) {
+        auto conn = server_->Accept(120.0);
+        if (!conn)
+          return Status::Unknown("controller: timeout waiting for workers");
+        // first frame from a worker is its rank
+        std::vector<uint8_t> hello;
+        Status s = conn->RecvFrame(hello);
+        if (!s.ok()) return s;
+        Reader r(hello);
+        int wrank = r.i32();
+        if (wrank <= 0 || wrank >= size_)
+          return Status::InvalidArgument("controller: bad hello rank");
+        workers_[wrank] = std::move(conn);
+        ++connected;
+      }
+    }
+  } else if (!coord_) {
+    coord_ = TcpConnection::Connect(coord_host_, control_port_, 120.0);
+    if (!coord_)
+      return Status::Unknown("controller: cannot reach coordinator at " +
+                             coord_host_ + ":" +
+                             std::to_string(control_port_));
+    Writer w;
+    w.i32(rank_);
+    Status s = coord_->SendFrame(w.data());
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status ControlPlane::Initialize(const std::string& advertise_host,
+                                int advertise_port,
+                                std::vector<PeerInfo>& roster) {
+  Status s = EnsureConnected();
+  if (!s.ok()) return s;
+  // gather (host, data_port) to rank 0, broadcast the roster
+  Writer mine;
+  mine.str(advertise_host);
+  mine.i32(advertise_port);
+  std::vector<std::vector<uint8_t>> all;
+  s = GatherFrames(mine.data(), all);
+  if (!s.ok()) return s;
+  std::vector<uint8_t> roster_bytes;
+  if (is_coordinator()) {
+    Writer w;
+    for (int i = 0; i < size_; ++i) {
+      Reader r(all[i]);
+      w.str(r.str());
+      w.i32(r.i32());
+    }
+    roster_bytes = w.take();
+  }
+  s = BcastFrame(roster_bytes, 0);
+  if (!s.ok()) return s;
+  roster.resize(size_);
+  Reader r(roster_bytes);
+  for (int i = 0; i < size_; ++i) {
+    roster[i].host = r.str();
+    roster[i].data_port = r.i32();
+  }
+  return Status::OK();
+}
+
+Status ControlPlane::GatherFrames(const std::vector<uint8_t>& mine,
+                                  std::vector<std::vector<uint8_t>>& all) {
+  if (size_ == 1) {
+    all.assign(1, mine);
+    return Status::OK();
+  }
+  if (is_coordinator()) {
+    all.assign(size_, {});
+    all[0] = mine;
+    for (int i = 1; i < size_; ++i) {
+      Status s = workers_[i]->RecvFrame(all[i]);
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+  return coord_->SendFrame(mine);
+}
+
+Status ControlPlane::BcastFrame(std::vector<uint8_t>& bytes, int root) {
+  if (size_ == 1) return Status::OK();
+  // non-zero roots relay through the coordinator
+  if (root != 0) {
+    if (rank_ == root) {
+      Status s = coord_->SendFrame(bytes);
+      if (!s.ok()) return s;
+    } else if (is_coordinator()) {
+      Status s = workers_[root]->RecvFrame(bytes);
+      if (!s.ok()) return s;
+    }
+    root = 0;
+  }
+  if (is_coordinator()) {
+    for (int i = 1; i < size_; ++i) {
+      Status s = workers_[i]->SendFrame(bytes);
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+  return coord_->RecvFrame(bytes);
+}
+
+Status ControlPlane::SendReadyTensors(const RequestList& reqs) {
+  Status s = EnsureConnected();
+  if (!s.ok()) return s;
+  return coord_->SendFrame(reqs.Serialize());
+}
+
+Status ControlPlane::RecvFinalTensors(ResponseList& resp) {
+  std::vector<uint8_t> buf;
+  Status s = coord_->RecvFrame(buf);
+  if (!s.ok()) return s;
+  resp = ResponseList::Deserialize(buf);
+  return Status::OK();
+}
+
+Status ControlPlane::RecvReadyTensors(std::vector<RequestList>& per_rank) {
+  Status s = EnsureConnected();
+  if (!s.ok()) return s;
+  per_rank.assign(size_, {});
+  for (int i = 1; i < size_; ++i) {
+    std::vector<uint8_t> buf;
+    s = workers_[i]->RecvFrame(buf);
+    if (!s.ok()) return s;
+    per_rank[i] = RequestList::Deserialize(buf);
+  }
+  return Status::OK();
+}
+
+Status ControlPlane::SendFinalTensors(const ResponseList& resp) {
+  auto bytes = resp.Serialize();
+  for (int i = 1; i < size_; ++i) {
+    Status s = workers_[i]->SendFrame(bytes);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status ControlPlane::Bcast(std::vector<uint8_t>& bytes, int root) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Status s = EnsureConnected();
+  if (!s.ok()) return s;
+  return BcastFrame(bytes, root);
+}
+
+Status ControlPlane::Barrier() {
+  std::lock_guard<std::mutex> lock(mu_);
+  Status s = EnsureConnected();
+  if (!s.ok()) return s;
+  std::vector<std::vector<uint8_t>> all;
+  s = GatherFrames({}, all);
+  if (!s.ok()) return s;
+  std::vector<uint8_t> empty;
+  return BcastFrame(empty, 0);
+}
+
+Status ControlPlane::BitAllreduce(std::vector<uint64_t>& bits, bool is_and) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Status s = EnsureConnected();
+  if (!s.ok()) return s;
+  std::vector<uint8_t> mine(bits.size() * 8);
+  std::copy(reinterpret_cast<uint8_t*>(bits.data()),
+            reinterpret_cast<uint8_t*>(bits.data()) + mine.size(),
+            mine.begin());
+  std::vector<std::vector<uint8_t>> all;
+  s = GatherFrames(mine, all);
+  if (!s.ok()) return s;
+  std::vector<uint8_t> result = mine;
+  if (is_coordinator()) {
+    for (int i = 1; i < size_; ++i) {
+      const uint64_t* other =
+          reinterpret_cast<const uint64_t*>(all[i].data());
+      uint64_t* acc = reinterpret_cast<uint64_t*>(result.data());
+      size_t n = std::min(all[i].size(), result.size()) / 8;
+      for (size_t j = 0; j < n; ++j)
+        acc[j] = is_and ? (acc[j] & other[j]) : (acc[j] | other[j]);
+    }
+  }
+  s = BcastFrame(result, 0);
+  if (!s.ok()) return s;
+  std::copy(result.data(), result.data() + result.size(),
+            reinterpret_cast<uint8_t*>(bits.data()));
+  return Status::OK();
+}
+
+}  // namespace hvd

@@ -1,0 +1,390 @@
+#include "hvd/cpu_ops.h"
+
+#include <cmath>
+#include <cstring>
+
+namespace hvd {
+
+namespace {
+
+// ---- fp16 / bf16 storage types and conversion --------------------------
+
+struct F16 { uint16_t v; };
+struct BF16 { uint16_t v; };
+
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400u) == 0) { mant <<= 1; --exp; }
+      mant &= 0x3ffu;
+      f = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    f = sign | 0x7f800000u | (mant << 13);
+  } else {
+    f = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToHalf(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((f >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = f & 0x7fffffu;
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7c00u);  // inf
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half_mant = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    if (rem > (1u << (shift - 1)) ||
+        (rem == (1u << (shift - 1)) && (half_mant & 1)))
+      ++half_mant;
+    return static_cast<uint16_t>(sign | half_mant);
+  }
+  uint32_t half = sign | (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) ++half;
+  return static_cast<uint16_t>(half);
+}
+
+inline float Bf16ToFloat(uint16_t h) {
+  uint32_t f = static_cast<uint32_t>(h) << 16;
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToBf16(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  uint32_t rounded = f + 0x7fffu + ((f >> 16) & 1);  // round-nearest-even
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+template <typename T> inline double Load(T v) {
+  return static_cast<double>(v);
+}
+inline double Load(F16 v) { return HalfToFloat(v.v); }
+inline double Load(BF16 v) { return Bf16ToFloat(v.v); }
+
+template <typename T> struct Store {
+  static T From(double d) { return static_cast<T>(d); }
+};
+template <> struct Store<F16> {
+  static F16 From(double d) {
+    return F16{FloatToHalf(static_cast<float>(d))};
+  }
+};
+template <> struct Store<BF16> {
+  static BF16 From(double d) {
+    return BF16{FloatToBf16(static_cast<float>(d))};
+  }
+};
+
+template <typename T>
+void ReduceIntoT(T* acc, const T* other, int64_t count, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:  // summation happens in the adasum schedule
+      for (int64_t i = 0; i < count; ++i)
+        acc[i] = Store<T>::From(Load(acc[i]) + Load(other[i]));
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < count; ++i)
+        if (Load(other[i]) < Load(acc[i])) acc[i] = other[i];
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < count; ++i)
+        if (Load(other[i]) > Load(acc[i])) acc[i] = other[i];
+      break;
+  }
+}
+
+template <typename T>
+void ScaleT(T* data, int64_t count, double factor) {
+  for (int64_t i = 0; i < count; ++i)
+    data[i] = Store<T>::From(Load(data[i]) * factor);
+}
+
+#define HVD_DISPATCH(dtype, expr_template)                                   \
+  switch (dtype) {                                                           \
+    case DataType::UINT8:    { using T = uint8_t;  expr_template; break; }   \
+    case DataType::INT8:     { using T = int8_t;   expr_template; break; }   \
+    case DataType::UINT16:   { using T = uint16_t; expr_template; break; }   \
+    case DataType::INT16:    { using T = int16_t;  expr_template; break; }   \
+    case DataType::INT32:    { using T = int32_t;  expr_template; break; }   \
+    case DataType::INT64:    { using T = int64_t;  expr_template; break; }   \
+    case DataType::FLOAT16:  { using T = F16;      expr_template; break; }   \
+    case DataType::FLOAT32:  { using T = float;    expr_template; break; }   \
+    case DataType::FLOAT64:  { using T = double;   expr_template; break; }   \
+    case DataType::BOOL:     { using T = uint8_t;  expr_template; break; }   \
+    case DataType::BFLOAT16: { using T = BF16;     expr_template; break; }   \
+  }
+
+}  // namespace
+
+void ReduceInto(void* acc, const void* other, int64_t count, DataType dtype,
+                ReduceOp op) {
+  HVD_DISPATCH(dtype, ReduceIntoT(static_cast<T*>(acc),
+                                  static_cast<const T*>(other), count, op));
+}
+
+void ScaleInPlace(void* data, int64_t count, DataType dtype, double factor) {
+  HVD_DISPATCH(dtype, ScaleT(static_cast<T*>(data), count, factor));
+}
+
+namespace {
+
+// chunk layout for the ring schedule: chunk i covers
+// [start_el(i), start_el(i) + len_el(i))
+struct Chunks {
+  int64_t base, rem;
+  Chunks(int64_t count, int n) : base(count / n), rem(count % n) {}
+  int64_t start(int i) const {
+    return static_cast<int64_t>(i) * base + std::min<int64_t>(i, rem);
+  }
+  int64_t len(int i) const { return base + (i < rem ? 1 : 0); }
+};
+
+}  // namespace
+
+Status RingAllreduce(PeerMesh& mesh, int rank, int size, void* data,
+                     int64_t count, DataType dtype, ReduceOp op) {
+  if (size == 1) {
+    return Status::OK();
+  }
+  size_t esz = DataTypeSize(dtype);
+  uint8_t* bytes = static_cast<uint8_t*>(data);
+  Chunks ch(count, size);
+  std::vector<uint8_t> tmp((ch.base + (ch.rem ? 1 : 0)) * esz);
+  int next = (rank + 1) % size;
+  int prev = (rank - 1 + size) % size;
+
+  // reduce-scatter: after N-1 steps rank r owns the full reduction of
+  // chunk (r+1) % N
+  for (int s = 0; s < size - 1; ++s) {
+    int send_c = (rank - s + size) % size;
+    int recv_c = (rank - s - 1 + size) % size;
+    Status st = mesh.RingStep(next, prev, bytes + ch.start(send_c) * esz,
+                              ch.len(send_c) * esz, tmp.data(),
+                              ch.len(recv_c) * esz);
+    if (!st.ok()) return st;
+    ReduceInto(bytes + ch.start(recv_c) * esz, tmp.data(), ch.len(recv_c),
+               dtype, op);
+  }
+  // allgather rotation
+  for (int s = 0; s < size - 1; ++s) {
+    int send_c = (rank + 1 - s + size) % size;
+    int recv_c = (rank - s + size) % size;
+    Status st = mesh.RingStep(next, prev, bytes + ch.start(send_c) * esz,
+                              ch.len(send_c) * esz,
+                              bytes + ch.start(recv_c) * esz,
+                              ch.len(recv_c) * esz);
+    if (!st.ok()) return st;
+  }
+  if (op == ReduceOp::AVERAGE)
+    ScaleInPlace(data, count, dtype, 1.0 / size);
+  return Status::OK();
+}
+
+Status RingAllgatherv(PeerMesh& mesh, int rank, int size, const void* input,
+                      const std::vector<int64_t>& counts, DataType dtype,
+                      void* output) {
+  size_t esz = DataTypeSize(dtype);
+  uint8_t* out = static_cast<uint8_t*>(output);
+  std::vector<int64_t> displs(size, 0);
+  for (int i = 1; i < size; ++i) displs[i] = displs[i - 1] + counts[i - 1];
+  std::memcpy(out + displs[rank] * esz, input, counts[rank] * esz);
+  if (size == 1) return Status::OK();
+  int next = (rank + 1) % size;
+  int prev = (rank - 1 + size) % size;
+  for (int s = 0; s < size - 1; ++s) {
+    int send_b = (rank - s + size) % size;
+    int recv_b = (rank - s - 1 + size) % size;
+    Status st = mesh.RingStep(next, prev, out + displs[send_b] * esz,
+                              counts[send_b] * esz,
+                              out + displs[recv_b] * esz,
+                              counts[recv_b] * esz);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status Broadcast(PeerMesh& mesh, int rank, int size, void* data,
+                 int64_t count, DataType dtype, int root) {
+  if (size == 1) return Status::OK();
+  size_t nbytes = count * DataTypeSize(dtype);
+  if (rank == root) {
+    for (int i = 0; i < size; ++i) {
+      if (i == root) continue;
+      Status st = mesh.SendTo(i, data, nbytes);
+      if (!st.ok()) return st;
+    }
+    return Status::OK();
+  }
+  return mesh.RecvFrom(root, data, nbytes);
+}
+
+Status AllToAll(PeerMesh& mesh, int rank, int size, const void* input,
+                int64_t block, DataType dtype, void* output) {
+  size_t bsz = block * DataTypeSize(dtype);
+  const uint8_t* in = static_cast<const uint8_t*>(input);
+  uint8_t* out = static_cast<uint8_t*>(output);
+  std::memcpy(out + rank * bsz, in + rank * bsz, bsz);
+  for (int r = 1; r < size; ++r) {
+    int send_to = (rank + r) % size;
+    int recv_from = (rank - r + size) % size;
+    Status st = mesh.RingStep(send_to, recv_from, in + send_to * bsz, bsz,
+                              out + recv_from * bsz, bsz);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+// ---- Adasum ------------------------------------------------------------
+
+namespace {
+
+// Orientation matters: `a` is always the bit-0 ("low") group's vector and
+// `b` the bit-1 group's, on BOTH sides of a pair — otherwise the group
+// norms |a|^2 and |b|^2 get mixed across ranks. `own_is_a` says which of
+// (own fragment, received fragment) plays the role of a.
+template <typename T>
+void PartialDots(const T* own, const T* other, int64_t n, bool own_is_a,
+                 double out[3]) {
+  double dot = 0, n_own = 0, n_other = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double x = Load(own[i]), y = Load(other[i]);
+    dot += x * y;
+    n_own += x * x;
+    n_other += y * y;
+  }
+  out[0] = dot;
+  out[1] = own_is_a ? n_own : n_other;   // |a|^2
+  out[2] = own_is_a ? n_other : n_own;   // |b|^2
+}
+
+template <typename T>
+void Combine(T* own, const T* other, int64_t n, bool own_is_a,
+             const double dots[3]) {
+  // result = a*(1 - dot/(2|a|^2)) + b*(1 - dot/(2|b|^2)) — the
+  // scale-insensitive pairwise merge (reference adasum.h:331+).
+  double ca = dots[1] > 0 ? 1.0 - dots[0] / (2.0 * dots[1]) : 1.0;
+  double cb = dots[2] > 0 ? 1.0 - dots[0] / (2.0 * dots[2]) : 1.0;
+  double c_own = own_is_a ? ca : cb;
+  double c_other = own_is_a ? cb : ca;
+  for (int64_t i = 0; i < n; ++i)
+    own[i] = Store<T>::From(c_own * Load(own[i]) +
+                            c_other * Load(other[i]));
+}
+
+struct LevelRecord {
+  int partner;
+  int64_t prev_start, prev_len;
+  int64_t start, len;  // fragment kept after the exchange
+};
+
+// recursive-doubling sum of 3 doubles over the aligned group of
+// `group_size` ranks containing `rank`
+Status GroupSumDots(PeerMesh& mesh, int rank, int group_size,
+                    double dots[3]) {
+  for (int e = 1; e < group_size; e <<= 1) {
+    int partner = rank ^ e;
+    double theirs[3];
+    Status st = mesh.SendRecv(partner, dots, sizeof(double) * 3, theirs,
+                              sizeof(double) * 3);
+    if (!st.ok()) return st;
+    for (int i = 0; i < 3; ++i) dots[i] += theirs[i];
+  }
+  return Status::OK();
+}
+
+template <typename T>
+Status AdasumT(PeerMesh& mesh, int rank, int size, T* data, int64_t count) {
+  std::vector<T> tmp(count);
+  std::vector<LevelRecord> stack;
+  int64_t start = 0, len = count;
+
+  for (int d = 1; d < size; d <<= 1) {
+    int partner = rank ^ d;
+    int64_t low_len = len / 2;
+    int64_t high_len = len - low_len;
+    bool keep_low = (rank & d) == 0;
+    int64_t my_start = keep_low ? start : start + low_len;
+    int64_t my_len = keep_low ? low_len : high_len;
+    int64_t send_start = keep_low ? start + low_len : start;
+    int64_t send_len = keep_low ? high_len : low_len;
+
+    // exchange halves: afterwards tmp[0..my_len) holds the partner's copy
+    // of MY half of the vector
+    Status st = mesh.SendRecv(partner, data + send_start,
+                              send_len * sizeof(T), tmp.data(),
+                              my_len * sizeof(T));
+    if (!st.ok()) return st;
+
+    bool own_is_a = (rank & d) == 0;  // bit-0 side is the "a" group
+    double dots[3];
+    PartialDots(data + my_start, tmp.data(), my_len, own_is_a, dots);
+    st = GroupSumDots(mesh, rank, d << 1, dots);
+    if (!st.ok()) return st;
+    Combine(data + my_start, tmp.data(), my_len, own_is_a, dots);
+
+    stack.push_back({partner, start, len, my_start, my_len});
+    start = my_start;
+    len = my_len;
+  }
+
+  // reconstruct: walk back up, exchanging fragments with each partner
+  for (auto it = stack.rbegin(); it != stack.rend(); ++it) {
+    int64_t other_start =
+        (it->start == it->prev_start) ? it->start + it->len : it->prev_start;
+    int64_t other_len = it->prev_len - it->len;
+    Status st = mesh.SendRecv(it->partner, data + it->start,
+                              it->len * sizeof(T), data + other_start,
+                              other_len * sizeof(T));
+    if (!st.ok()) return st;
+    start = it->prev_start;
+    len = it->prev_len;
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+Status AdasumAllreduce(PeerMesh& mesh, ControlPlane& control, int rank,
+                       int size, void* data, int64_t count, DataType dtype) {
+  (void)control;
+  if (size == 1) return Status::OK();
+  if ((size & (size - 1)) != 0)
+    return Status::InvalidArgument(
+        "Adasum requires a power-of-2 number of ranks (got " +
+        std::to_string(size) + ")");
+  switch (dtype) {
+    case DataType::FLOAT16:
+      return AdasumT(mesh, rank, size, static_cast<F16*>(data), count);
+    case DataType::BFLOAT16:
+      return AdasumT(mesh, rank, size, static_cast<BF16*>(data), count);
+    case DataType::FLOAT32:
+      return AdasumT(mesh, rank, size, static_cast<float*>(data), count);
+    case DataType::FLOAT64:
+      return AdasumT(mesh, rank, size, static_cast<double*>(data), count);
+    default:
+      return Status::InvalidArgument("Adasum supports float dtypes only");
+  }
+}
+
+}  // namespace hvd

@@ -1,0 +1,113 @@
+#include "hvd/message.h"
+
+namespace hvd {
+
+const char* RequestTypeName(Request::Type t) {
+  switch (t) {
+    case Request::ALLREDUCE: return "ALLREDUCE";
+    case Request::ALLGATHER: return "ALLGATHER";
+    case Request::BROADCAST: return "BROADCAST";
+    case Request::JOIN: return "JOIN";
+    case Request::ADASUM: return "ADASUM";
+    case Request::ALLTOALL: return "ALLTOALL";
+    case Request::REDUCESCATTER: return "REDUCESCATTER";
+    case Request::BARRIER: return "BARRIER";
+  }
+  return "UNKNOWN";
+}
+
+void Request::Serialize(Writer& w) const {
+  w.u8(type);
+  w.i32(request_rank);
+  w.u8(static_cast<uint8_t>(dtype));
+  w.str(tensor_name);
+  w.i32(root_rank);
+  w.i32(shape.ndim());
+  for (int i = 0; i < shape.ndim(); ++i) w.i64(shape.dim(i));
+  w.i64(static_cast<int64_t>(prescale_factor * 1e9));
+  w.i64(static_cast<int64_t>(postscale_factor * 1e9));
+  w.u8(reduce_op);
+}
+
+Request Request::Deserialize(Reader& r) {
+  Request q;
+  q.type = static_cast<Type>(r.u8());
+  q.request_rank = r.i32();
+  q.dtype = static_cast<DataType>(r.u8());
+  q.tensor_name = r.str();
+  q.root_rank = r.i32();
+  int ndim = r.i32();
+  for (int i = 0; i < ndim; ++i) q.shape.AddDim(r.i64());
+  q.prescale_factor = static_cast<double>(r.i64()) / 1e9;
+  q.postscale_factor = static_cast<double>(r.i64()) / 1e9;
+  q.reduce_op = r.u8();
+  return q;
+}
+
+void Response::Serialize(Writer& w) const {
+  w.u8(type);
+  w.i32(static_cast<int32_t>(tensor_names.size()));
+  for (const auto& n : tensor_names) w.str(n);
+  w.str(error_message);
+  w.i32(static_cast<int32_t>(tensor_sizes.size()));
+  for (int64_t s : tensor_sizes) w.i64(s);
+  w.u8(static_cast<uint8_t>(dtype));
+  w.u8(reduce_op);
+  w.i32(active_ranks);
+}
+
+Response Response::Deserialize(Reader& r) {
+  Response p;
+  p.type = static_cast<Type>(r.u8());
+  int32_t n = r.i32();
+  p.tensor_names.reserve(n);
+  for (int32_t i = 0; i < n; ++i) p.tensor_names.push_back(r.str());
+  p.error_message = r.str();
+  int32_t m = r.i32();
+  p.tensor_sizes.reserve(m);
+  for (int32_t i = 0; i < m; ++i) p.tensor_sizes.push_back(r.i64());
+  p.dtype = static_cast<DataType>(r.u8());
+  p.reduce_op = r.u8();
+  p.active_ranks = r.i32();
+  return p;
+}
+
+std::vector<uint8_t> RequestList::Serialize() const {
+  Writer w;
+  w.u8(shutdown ? 1 : 0);
+  w.i32(static_cast<int32_t>(requests.size()));
+  for (const auto& q : requests) q.Serialize(w);
+  return w.take();
+}
+
+RequestList RequestList::Deserialize(const std::vector<uint8_t>& buf) {
+  Reader r(buf);
+  RequestList l;
+  l.shutdown = r.u8() != 0;
+  int32_t n = r.i32();
+  l.requests.reserve(n);
+  for (int32_t i = 0; i < n; ++i)
+    l.requests.push_back(Request::Deserialize(r));
+  return l;
+}
+
+std::vector<uint8_t> ResponseList::Serialize() const {
+  Writer w;
+  w.u8(shutdown ? 1 : 0);
+  w.i32(static_cast<int32_t>(responses.size()));
+  for (const auto& p : responses) p.Serialize(w);
+  return w.take();
+}
+
+ResponseList ResponseList::Deserialize(const std::vector<uint8_t>& buf) {
+  Reader r(buf);
+  ResponseList l;
+  l.shutdown = r.u8() != 0;
+  int32_t n = r.i32();
+  l.responses.reserve(n);
+  for (int32_t i = 0; i < n; ++i)
+    l.responses.push_back(Response::Deserialize(r));
+  return l;
+}
+
+}  // namespace hvd

@@ -1,0 +1,169 @@
+#include "hvd/negotiator.h"
+
+#include <algorithm>
+
+namespace hvd {
+
+std::vector<std::string> Negotiator::AddRequests(
+    const std::vector<Request>& reqs, int joined_count) {
+  std::vector<std::string> ready;
+  for (const auto& q : reqs) {
+    auto& slot = message_table_[q.tensor_name];
+    if (slot.empty()) arrival_order_.push_back(q.tensor_name);
+    slot.push_back(q);
+    if (static_cast<int>(slot.size()) == size_ - joined_count)
+      ready.push_back(q.tensor_name);
+  }
+  return ready;
+}
+
+std::vector<std::string> Negotiator::ReadyAfterJoin(int joined_count) {
+  std::vector<std::string> ready;
+  for (const auto& name : arrival_order_) {
+    auto it = message_table_.find(name);
+    if (it != message_table_.end() &&
+        static_cast<int>(it->second.size()) >= size_ - joined_count)
+      ready.push_back(name);
+  }
+  return ready;
+}
+
+Response Negotiator::BuildResponse(const std::string& name) {
+  auto it = message_table_.find(name);
+  Response resp;
+  resp.tensor_names = {name};
+  if (it == message_table_.end()) {
+    resp.type = Response::ERROR;
+    resp.error_message = "tensor " + name + " not in negotiation table";
+    return resp;
+  }
+  std::vector<Request> reqs = std::move(it->second);
+  message_table_.erase(it);
+  arrival_order_.erase(
+      std::remove(arrival_order_.begin(), arrival_order_.end(), name),
+      arrival_order_.end());
+
+  const Request& first = reqs[0];
+  resp.dtype = first.dtype;
+
+  auto fail = [&](const std::string& msg) {
+    resp.type = Response::ERROR;
+    resp.error_message = "tensor " + name + ": " + msg;
+    return resp;
+  };
+
+  // cross-rank agreement checks (reference ConstructResponse,
+  // controller.cc:368-610)
+  for (const auto& q : reqs) {
+    if (q.type != first.type)
+      return fail("mismatched collective types across ranks");
+    if (q.dtype != first.dtype)
+      return fail("mismatched dtypes across ranks");
+    if (q.reduce_op != first.reduce_op)
+      return fail("mismatched reduction ops across ranks");
+  }
+  resp.reduce_op = first.reduce_op;
+  switch (first.type) {
+    case Request::ALLREDUCE:
+    case Request::ADASUM:
+    case Request::ALLTOALL:
+    case Request::REDUCESCATTER:
+      for (const auto& q : reqs)
+        if (q.shape != first.shape)
+          return fail("mismatched shapes across ranks (" +
+                      first.shape.DebugString() + " vs " +
+                      q.shape.DebugString() + ")");
+      resp.type = static_cast<Response::Type>(first.type);
+      resp.tensor_sizes = {first.shape.num_elements()};
+      break;
+    case Request::BROADCAST: {
+      for (const auto& q : reqs) {
+        if (q.root_rank != first.root_rank)
+          return fail("mismatched broadcast root ranks");
+        if (q.shape != first.shape)
+          return fail("mismatched shapes across ranks");
+      }
+      resp.type = Response::BROADCAST;
+      resp.tensor_sizes = {first.shape.num_elements()};
+      break;
+    }
+    case Request::ALLGATHER: {
+      // shapes must agree on all dims but the first; record per-rank
+      // first dims in rank order
+      std::vector<int64_t> first_dims(reqs.size(), 0);
+      for (const auto& q : reqs) {
+        if (q.shape.ndim() != first.shape.ndim() || q.shape.ndim() == 0)
+          return fail("allgather rank mismatch or zero-dim tensor");
+        for (int d = 1; d < q.shape.ndim(); ++d)
+          if (q.shape.dim(d) != first.shape.dim(d))
+            return fail("allgather shapes differ beyond the first dim");
+      }
+      std::sort(reqs.begin(), reqs.end(),
+                [](const Request& a, const Request& b) {
+                  return a.request_rank < b.request_rank;
+                });
+      resp.tensor_sizes.clear();
+      for (const auto& q : reqs) resp.tensor_sizes.push_back(q.shape.dim(0));
+      resp.type = Response::ALLGATHER;
+      break;
+    }
+    case Request::BARRIER:
+      resp.type = Response::BARRIER;
+      break;
+    case Request::JOIN:
+      resp.type = Response::JOIN;
+      break;
+  }
+  return resp;
+}
+
+std::vector<Response> Negotiator::Fuse(std::vector<Response> responses,
+                                       int64_t threshold_bytes) {
+  std::vector<Response> out;
+  std::vector<bool> used(responses.size(), false);
+  for (size_t i = 0; i < responses.size(); ++i) {
+    if (used[i]) continue;
+    Response& r = responses[i];
+    used[i] = true;
+    bool fusable = (r.type == Response::ALLREDUCE ||
+                    r.type == Response::ADASUM) &&
+                   r.error_message.empty();
+    if (!fusable) {
+      out.push_back(std::move(r));
+      continue;
+    }
+    int64_t esz = static_cast<int64_t>(DataTypeSize(r.dtype));
+    int64_t bytes = r.tensor_sizes[0] * esz;
+    // look-ahead: pull in later compatible responses while room remains
+    for (size_t j = i + 1; j < responses.size(); ++j) {
+      if (used[j]) continue;
+      const Response& c = responses[j];
+      if (c.type != r.type || c.dtype != r.dtype ||
+          c.reduce_op != r.reduce_op || !c.error_message.empty())
+        continue;
+      int64_t cbytes = c.tensor_sizes[0] * esz;
+      if (bytes + cbytes > threshold_bytes) continue;
+      r.tensor_names.push_back(c.tensor_names[0]);
+      r.tensor_sizes.push_back(c.tensor_sizes[0]);
+      bytes += cbytes;
+      used[j] = true;
+    }
+    out.push_back(std::move(r));
+  }
+  return out;
+}
+
+std::vector<std::pair<std::string, std::vector<int>>> Negotiator::Pending()
+    const {
+  std::vector<std::pair<std::string, std::vector<int>>> out;
+  for (const auto& name : arrival_order_) {
+    auto it = message_table_.find(name);
+    if (it == message_table_.end()) continue;
+    std::vector<int> ranks;
+    for (const auto& q : it->second) ranks.push_back(q.request_rank);
+    out.emplace_back(name, std::move(ranks));
+  }
+  return out;
+}
+
+}  // namespace hvd

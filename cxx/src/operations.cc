@@ -1,0 +1,593 @@
+#include "hvd/operations.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+
+#include "hvd/controller.h"
+#include "hvd/cpu_ops.h"
+#include "hvd/negotiator.h"
+#include "hvd/peer_mesh.h"
+#include "hvd/response_cache.h"
+#include "hvd/stall_inspector.h"
+#include "hvd/tensor_queue.h"
+#include "hvd/timeline.h"
+
+namespace hvd {
+namespace {
+
+// ---- handle manager (reference: torch/handle_manager.{h,cc}) -----------
+
+struct HandleState {
+  bool done = false;
+  Status status;
+  std::vector<uint8_t> output;
+};
+
+class HandleManager {
+ public:
+  int Allocate() {
+    std::lock_guard<std::mutex> lock(mu_);
+    int h = next_++;
+    states_[h];
+    return h;
+  }
+  void MarkDone(int h, Status s, std::vector<uint8_t> output = {}) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto it = states_.find(h);
+      if (it == states_.end()) return;
+      it->second.done = true;
+      it->second.status = std::move(s);
+      it->second.output = std::move(output);
+    }
+    cv_.notify_all();
+  }
+  // 0 pending, 1 ok, -1 error, -2 unknown handle
+  int Poll(int h) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = states_.find(h);
+    if (it == states_.end()) return -2;
+    if (!it->second.done) return 0;
+    return it->second.status.ok() ? 1 : -1;
+  }
+  int Wait(int h) {
+    std::unique_lock<std::mutex> lock(mu_);
+    auto it = states_.find(h);
+    if (it == states_.end()) return -2;
+    cv_.wait(lock, [&] { return states_.at(h).done; });
+    return states_.at(h).status.ok() ? 1 : -1;
+  }
+  std::string ErrorMessage(int h) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = states_.find(h);
+    return it == states_.end() ? "unknown handle" : it->second.status.reason();
+  }
+  int64_t OutputSize(int h) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = states_.find(h);
+    if (it == states_.end() || !it->second.done) return -1;
+    return static_cast<int64_t>(it->second.output.size());
+  }
+  bool CopyOutput(int h, void* dst) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = states_.find(h);
+    if (it == states_.end() || !it->second.done) return false;
+    std::memcpy(dst, it->second.output.data(), it->second.output.size());
+    return true;
+  }
+  void Release(int h) {
+    std::lock_guard<std::mutex> lock(mu_);
+    states_.erase(h);
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<int, HandleState> states_;
+  int next_ = 0;
+};
+
+// ---- global state (reference: common/global_state.h) -------------------
+
+struct Global {
+  int rank = 0;
+  int size = 1;
+  std::unique_ptr<ControlPlane> control;
+  std::unique_ptr<PeerMesh> mesh;
+  TensorQueue queue;
+  HandleManager handles;
+  Negotiator negotiator{1};
+  ResponseCache cache;
+  StallInspector stall;
+  Timeline timeline;
+
+  std::thread loop_thread;
+  std::atomic<bool> shutdown_requested{false};
+  std::atomic<bool> initialized{false};
+  double cycle_time_ms = 1.0;
+  int64_t fusion_threshold = 64 * 1024 * 1024;
+
+  // join state
+  std::vector<bool> joined_ranks;     // coordinator
+  bool self_joined = false;
+  int join_handle = -1;
+  std::mutex join_mu;
+
+  std::string last_error;
+};
+
+Global* g = nullptr;
+
+int JoinedCount() {
+  int c = 0;
+  for (bool b : g->joined_ranks)
+    if (b) c += 1;
+  return c;
+}
+
+// ---- execution (reference: PerformOperation, operations.cc:227-304) ----
+
+void CompleteEntry(TensorTableEntry& e, const Status& s) {
+  if (e.handle >= 0)
+    g->handles.MarkDone(e.handle, s, std::move(e.data));
+}
+
+void ExecuteFusedAllreduce(const Response& resp) {
+  size_t esz = DataTypeSize(resp.dtype);
+  int64_t total = 0;
+  for (int64_t c : resp.tensor_sizes) total += c;
+
+  std::vector<TensorTableEntry> entries(resp.tensor_names.size());
+  std::vector<bool> have(resp.tensor_names.size(), false);
+  for (size_t i = 0; i < resp.tensor_names.size(); ++i)
+    have[i] = g->queue.Take(resp.tensor_names[i], entries[i]);
+
+  // fusion buffer (reference FusionBufferManager + MemcpyInFusionBuffer) —
+  // joined ranks contribute zeros (reference tensor_queue.h:39-41)
+  std::vector<uint8_t> fused(total * esz, 0);
+  int64_t off = 0;
+  for (size_t i = 0; i < entries.size(); ++i) {
+    int64_t nbytes = resp.tensor_sizes[i] * esz;
+    if (have[i]) {
+      if (entries[i].prescale != 1.0)
+        ScaleInPlace(entries[i].data.data(), resp.tensor_sizes[i],
+                     resp.dtype, entries[i].prescale);
+      std::memcpy(fused.data() + off, entries[i].data.data(), nbytes);
+    }
+    off += nbytes;
+  }
+
+  ReduceOp op = static_cast<ReduceOp>(resp.reduce_op);
+
+  Status st;
+  g->timeline.ActivityStart(resp.tensor_names[0],
+                            resp.type == Response::ADASUM
+                                ? "ADASUM_ALLREDUCE" : "RING_ALLREDUCE");
+  if (resp.type == Response::ADASUM) {
+    st = AdasumAllreduce(*g->mesh, *g->control, g->rank, g->size,
+                         fused.data(), total, resp.dtype);
+  } else {
+    // AVERAGE divides by the number of *contributing* (non-joined) ranks
+    ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
+    st = RingAllreduce(*g->mesh, g->rank, g->size, fused.data(), total,
+                       resp.dtype, wire_op);
+    if (st.ok() && op == ReduceOp::AVERAGE) {
+      int active = resp.active_ranks > 0 ? resp.active_ranks : g->size;
+      ScaleInPlace(fused.data(), total, resp.dtype, 1.0 / active);
+    }
+  }
+  g->timeline.ActivityEnd(resp.tensor_names[0]);
+
+  off = 0;
+  for (size_t i = 0; i < entries.size(); ++i) {
+    int64_t nbytes = resp.tensor_sizes[i] * esz;
+    if (have[i]) {
+      std::memcpy(entries[i].data.data(), fused.data() + off, nbytes);
+      if (st.ok() && entries[i].postscale != 1.0)
+        ScaleInPlace(entries[i].data.data(), resp.tensor_sizes[i],
+                     resp.dtype, entries[i].postscale);
+      CompleteEntry(entries[i], st);
+    }
+    off += nbytes;
+  }
+}
+
+void ExecuteAllgather(const Response& resp) {
+  TensorTableEntry e;
+  if (!g->queue.Take(resp.tensor_names[0], e)) return;  // joined: no-op
+  size_t esz = DataTypeSize(resp.dtype);
+  int64_t row = 1;
+  for (int d = 1; d < e.shape.ndim(); ++d) row *= e.shape.dim(d);
+  std::vector<int64_t> counts;
+  int64_t total = 0;
+  for (int64_t dim0 : resp.tensor_sizes) {
+    counts.push_back(dim0 * row);
+    total += dim0 * row;
+  }
+  std::vector<uint8_t> out(total * esz);
+  g->timeline.ActivityStart(e.name, "RING_ALLGATHER");
+  Status st = RingAllgatherv(*g->mesh, g->rank, g->size, e.data.data(),
+                             counts, resp.dtype, out.data());
+  g->timeline.ActivityEnd(e.name);
+  e.data = std::move(out);
+  CompleteEntry(e, st);
+}
+
+void ExecuteBroadcast(const Response& resp) {
+  TensorTableEntry e;
+  if (!g->queue.Take(resp.tensor_names[0], e)) return;
+  g->timeline.ActivityStart(e.name, "BROADCAST");
+  Status st = Broadcast(*g->mesh, g->rank, g->size, e.data.data(),
+                        resp.tensor_sizes[0], resp.dtype, e.root_rank);
+  g->timeline.ActivityEnd(e.name);
+  CompleteEntry(e, st);
+}
+
+void ExecuteAlltoall(const Response& resp) {
+  TensorTableEntry e;
+  if (!g->queue.Take(resp.tensor_names[0], e)) return;
+  int64_t count = resp.tensor_sizes[0];
+  Status st;
+  if (count % g->size != 0) {
+    st = Status::InvalidArgument(
+        "alltoall requires first dim divisible by size");
+    CompleteEntry(e, st);
+    return;
+  }
+  std::vector<uint8_t> out(e.data.size());
+  g->timeline.ActivityStart(e.name, "ALLTOALL");
+  st = AllToAll(*g->mesh, g->rank, g->size, e.data.data(), count / g->size,
+                resp.dtype, out.data());
+  g->timeline.ActivityEnd(e.name);
+  e.data = std::move(out);
+  CompleteEntry(e, st);
+}
+
+void ExecuteBarrier(const Response& resp) {
+  TensorTableEntry e;
+  bool have = g->queue.Take(resp.tensor_names[0], e);
+  uint8_t one = 1;
+  Status st = RingAllreduce(*g->mesh, g->rank, g->size, &one, 1,
+                            DataType::UINT8, ReduceOp::MAX);
+  if (have) CompleteEntry(e, st);
+}
+
+void ExecuteError(const Response& resp) {
+  for (const auto& name : resp.tensor_names) {
+    TensorTableEntry e;
+    if (g->queue.Take(name, e))
+      CompleteEntry(e, Status::InvalidArgument(resp.error_message));
+  }
+}
+
+void ExecuteResponse(const Response& resp) {
+  switch (resp.type) {
+    case Response::ALLREDUCE:
+    case Response::ADASUM:
+      ExecuteFusedAllreduce(resp);
+      break;
+    case Response::ALLGATHER:
+      ExecuteAllgather(resp);
+      break;
+    case Response::BROADCAST:
+      ExecuteBroadcast(resp);
+      break;
+    case Response::ALLTOALL:
+      ExecuteAlltoall(resp);
+      break;
+    case Response::REDUCESCATTER:
+      // host path executes as allreduce; callers slice (XLA path has the
+      // real reduce-scatter)
+      ExecuteFusedAllreduce(resp);
+      break;
+    case Response::BARRIER:
+      ExecuteBarrier(resp);
+      break;
+    case Response::JOIN: {
+      std::lock_guard<std::mutex> lock(g->join_mu);
+      if (g->join_handle >= 0) {
+        g->handles.MarkDone(g->join_handle, Status::OK());
+        g->join_handle = -1;
+      }
+      g->self_joined = false;
+      std::fill(g->joined_ranks.begin(), g->joined_ranks.end(), false);
+      break;
+    }
+    case Response::ERROR:
+      ExecuteError(resp);
+      break;
+  }
+}
+
+// ---- negotiation cycle (reference: RunLoopOnce + ComputeResponseList) --
+
+ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
+  ResponseList rl;
+  bool any_shutdown = false;
+  bool join_changed = false;
+  std::vector<std::string> ready;
+  std::unordered_set<std::string> seen;
+
+  for (int r = 0; r < g->size; ++r) {
+    if (per_rank[r].shutdown) any_shutdown = true;
+    std::vector<Request> normal;
+    for (auto& q : per_rank[r].requests) {
+      if (q.type == Request::JOIN) {
+        if (!g->joined_ranks[r]) {
+          g->joined_ranks[r] = true;
+          join_changed = true;
+        }
+      } else {
+        normal.push_back(std::move(q));
+      }
+    }
+    for (const auto& name :
+         g->negotiator.AddRequests(normal, JoinedCount()))
+      if (seen.insert(name).second) ready.push_back(name);
+  }
+  if (join_changed) {
+    for (const auto& name : g->negotiator.ReadyAfterJoin(JoinedCount()))
+      if (seen.insert(name).second) ready.push_back(name);
+  }
+
+  int active = g->size - JoinedCount();
+  for (const auto& name : ready) {
+    g->timeline.NegotiateEnd(name);
+    Response r = g->negotiator.BuildResponse(name);
+    r.active_ranks = active;
+    rl.responses.push_back(std::move(r));
+  }
+  rl.responses = Negotiator::Fuse(std::move(rl.responses),
+                                  g->fusion_threshold);
+
+  // all ranks joined -> emit JOIN response (reference controller.cc:290)
+  if (g->size > 0 && JoinedCount() == g->size)
+    rl.responses.push_back([] {
+      Response r;
+      r.type = Response::JOIN;
+      r.tensor_names = {"join.noname"};
+      return r;
+    }());
+
+  if (g->stall.Check(g->negotiator.Pending(), g->size)) any_shutdown = true;
+  rl.shutdown = any_shutdown;
+  return rl;
+}
+
+bool RunLoopOnce() {
+  RequestList mine;
+  mine.requests = g->queue.PopRequests();
+  {
+    std::lock_guard<std::mutex> lock(g->join_mu);
+    if (g->self_joined) {
+      Request jq;
+      jq.type = Request::JOIN;
+      jq.request_rank = g->rank;
+      mine.requests.push_back(jq);
+      g->self_joined = false;  // announce once
+    }
+  }
+  mine.shutdown = g->shutdown_requested.load();
+  for (const auto& q : mine.requests)
+    if (q.type != Request::JOIN)
+      g->timeline.NegotiateStart(q.tensor_name, RequestTypeName(q.type));
+
+  ResponseList rl;
+  if (g->size == 1) {
+    std::vector<RequestList> per_rank{mine};
+    rl = CoordinatorNegotiate(per_rank);
+  } else if (g->control->is_coordinator()) {
+    std::vector<RequestList> per_rank;
+    Status s = g->control->RecvReadyTensors(per_rank);
+    if (!s.ok()) return false;
+    per_rank[0] = std::move(mine);
+    rl = CoordinatorNegotiate(per_rank);
+    s = g->control->SendFinalTensors(rl);
+    if (!s.ok()) return false;
+  } else {
+    Status s = g->control->SendReadyTensors(mine);
+    if (!s.ok()) return false;
+    s = g->control->RecvFinalTensors(rl);
+    if (!s.ok()) return false;
+  }
+
+  for (const auto& resp : rl.responses) {
+    g->timeline.Start(resp.tensor_names[0],
+                      std::string("OP_") + std::to_string(resp.type));
+    ExecuteResponse(resp);
+    g->timeline.End(resp.tensor_names[0]);
+  }
+  g->timeline.MarkCycle();
+  return !rl.shutdown;
+}
+
+void BackgroundLoop() {
+  while (RunLoopOnce()) {
+    std::this_thread::sleep_for(
+        std::chrono::duration<double, std::milli>(g->cycle_time_ms));
+  }
+  // fail anything still pending (reference SHUT_DOWN_ERROR)
+  for (auto& e : g->queue.DrainAll())
+    CompleteEntry(e, Status::Aborted(
+        "horovod_tpu core shut down before this op completed"));
+  {
+    std::lock_guard<std::mutex> lock(g->join_mu);
+    if (g->join_handle >= 0) {
+      g->handles.MarkDone(g->join_handle, Status::Aborted("shutdown"));
+      g->join_handle = -1;
+    }
+  }
+}
+
+}  // namespace
+}  // namespace hvd
+
+// ---- C API -------------------------------------------------------------
+
+using namespace hvd;
+
+int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
+              const char* advertise_host) {
+  if (g != nullptr && g->initialized.load()) return 0;
+  if (g != nullptr) {  // re-init after shutdown
+    delete g;
+    g = nullptr;
+  }
+  auto* ng = new Global();
+  ng->rank = rank;
+  ng->size = size;
+  ng->negotiator = Negotiator(size);
+  ng->joined_ranks.assign(size, false);
+  ng->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
+  ng->fusion_threshold =
+      EnvInt("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  ng->stall = StallInspector(
+      EnvDouble("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+      EnvDouble("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0));
+
+  if (size > 1) {
+    ng->mesh = std::make_unique<PeerMesh>(rank, size);
+    Status s = ng->mesh->Start();
+    if (!s.ok()) {
+      ng->last_error = s.reason();
+      g = ng;
+      return 1;
+    }
+    ng->control = std::make_unique<ControlPlane>(
+        rank, size, coord_host ? coord_host : "127.0.0.1", coord_port);
+    std::vector<PeerInfo> roster;
+    s = ng->control->Initialize(
+        advertise_host ? advertise_host : "127.0.0.1", ng->mesh->port(),
+        roster);
+    if (!s.ok()) {
+      ng->last_error = s.reason();
+      g = ng;
+      return 1;
+    }
+    ng->mesh->SetRoster(std::move(roster));
+  }
+
+  // coordinator-only, like the reference (operations.cc:388-395)
+  std::string tl = EnvStr("HOROVOD_TIMELINE", "");
+  if (!tl.empty() && rank == 0) ng->timeline.Initialize(tl, rank);
+
+  g = ng;
+  g->initialized.store(true);
+  g->loop_thread = std::thread(BackgroundLoop);
+  return 0;
+}
+
+int hvdc_shutdown() {
+  if (g == nullptr || !g->initialized.load()) return 0;
+  g->shutdown_requested.store(true);
+  if (g->loop_thread.joinable()) g->loop_thread.join();
+  g->timeline.Shutdown();
+  if (g->mesh) g->mesh->Shutdown();
+  g->initialized.store(false);
+  return 0;
+}
+
+int hvdc_is_initialized() {
+  return (g != nullptr && g->initialized.load()) ? 1 : 0;
+}
+
+int hvdc_rank() { return g ? g->rank : -1; }
+int hvdc_size() { return g ? g->size : -1; }
+
+int hvdc_enqueue(int type, const char* name, const void* data,
+                 const int64_t* shape, int ndim, int dtype, int op,
+                 int root_rank, double prescale, double postscale) {
+  if (g == nullptr || !g->initialized.load()) {
+    if (g) g->last_error = "horovod_tpu core is not initialized";
+    return -1;
+  }
+  TensorTableEntry e;
+  e.name = name;
+  e.type = static_cast<Request::Type>(type);
+  e.dtype = static_cast<DataType>(dtype);
+  for (int i = 0; i < ndim; ++i) e.shape.AddDim(shape[i]);
+  e.root_rank = root_rank;
+  e.op = static_cast<ReduceOp>(op);
+  e.prescale = prescale;
+  e.postscale = postscale;
+  size_t nbytes = e.shape.num_elements() * DataTypeSize(e.dtype);
+  e.data.resize(nbytes);
+  if (data != nullptr) std::memcpy(e.data.data(), data, nbytes);
+  e.handle = g->handles.Allocate();
+  int handle = e.handle;
+
+  Request q;
+  q.type = (e.op == ReduceOp::ADASUM && e.type == Request::ALLREDUCE)
+               ? Request::ADASUM : e.type;
+  q.request_rank = g->rank;
+  q.dtype = e.dtype;
+  q.tensor_name = e.name;
+  q.root_rank = e.root_rank;
+  q.shape = e.shape;
+  q.prescale_factor = prescale;
+  q.postscale_factor = postscale;
+  q.reduce_op = static_cast<uint8_t>(op);
+
+  Status s = g->queue.Add(std::move(e), q);
+  if (!s.ok()) {
+    g->handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+int hvdc_enqueue_join() {
+  if (g == nullptr || !g->initialized.load()) return -1;
+  std::lock_guard<std::mutex> lock(g->join_mu);
+  if (g->join_handle >= 0) return g->join_handle;
+  g->join_handle = g->handles.Allocate();
+  g->self_joined = true;
+  return g->join_handle;
+}
+
+int hvdc_poll(int handle) { return g ? g->handles.Poll(handle) : -2; }
+int hvdc_wait(int handle) { return g ? g->handles.Wait(handle) : -2; }
+
+const char* hvdc_error_message(int handle) {
+  static thread_local std::string msg;
+  msg = g ? g->handles.ErrorMessage(handle) : "core not initialized";
+  return msg.c_str();
+}
+
+const char* hvdc_last_error() {
+  static thread_local std::string msg;
+  msg = g ? g->last_error : "core not initialized";
+  return msg.c_str();
+}
+
+int64_t hvdc_output_size(int handle) {
+  return g ? g->handles.OutputSize(handle) : -1;
+}
+
+int hvdc_copy_output(int handle, void* dst) {
+  return (g && g->handles.CopyOutput(handle, dst)) ? 0 : 1;
+}
+
+void hvdc_release(int handle) {
+  if (g) g->handles.Release(handle);
+}
+
+int hvdc_barrier() {
+  if (g == nullptr || !g->initialized.load()) return 1;
+  static std::atomic<int> counter{0};
+  std::string name = "barrier." + std::to_string(counter.fetch_add(1));
+  int64_t shape = 1;
+  uint8_t one = 1;
+  int h = hvdc_enqueue(Request::BARRIER, name.c_str(), &one, &shape, 1,
+                       static_cast<int>(DataType::UINT8),
+                       static_cast<int>(ReduceOp::MAX), -1, 1.0, 1.0);
+  if (h < 0) return 1;
+  int rv = hvdc_wait(h);
+  hvdc_release(h);
+  return rv == 1 ? 0 : 1;
+}

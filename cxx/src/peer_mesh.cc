@@ -1,0 +1,192 @@
+#include "hvd/peer_mesh.h"
+
+#include <poll.h>
+#include <sys/socket.h>
+
+#include <cstring>
+
+namespace hvd {
+
+Status Progress(std::vector<Transfer>& transfers) {
+  while (true) {
+    std::vector<struct pollfd> pfds;
+    std::vector<size_t> idx;
+    for (size_t i = 0; i < transfers.size(); ++i) {
+      Transfer& t = transfers[i];
+      if (t.done >= t.len) continue;
+      struct pollfd p;
+      p.fd = t.fd;
+      p.events = t.is_send ? POLLOUT : POLLIN;
+      p.revents = 0;
+      pfds.push_back(p);
+      idx.push_back(i);
+    }
+    if (pfds.empty()) return Status::OK();
+    int rv = ::poll(pfds.data(), pfds.size(), 60000);
+    if (rv < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("poll failed: ") +
+                             std::strerror(errno));
+    }
+    if (rv == 0) return Status::Unknown("data-plane transfer timed out");
+    for (size_t k = 0; k < pfds.size(); ++k) {
+      if (pfds[k].revents == 0) continue;
+      Transfer& t = transfers[idx[k]];
+      if (pfds[k].revents & (POLLERR | POLLHUP | POLLNVAL)) {
+        // HUP with pending inbound data is still readable; try the IO and
+        // let it report the real error.
+      }
+      ssize_t n;
+      if (t.is_send) {
+        n = ::send(t.fd, t.send_buf + t.done, t.len - t.done, MSG_NOSIGNAL);
+      } else {
+        n = ::recv(t.fd, t.recv_buf + t.done, t.len - t.done, 0);
+        if (n == 0) return Status::Aborted("peer closed connection");
+      }
+      if (n < 0) {
+        if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
+          continue;
+        return Status::Unknown(std::string(t.is_send ? "send" : "recv") +
+                               " failed: " + std::strerror(errno));
+      }
+      t.done += static_cast<size_t>(n);
+    }
+  }
+}
+
+PeerMesh::PeerMesh(int rank, int size) : rank_(rank), size_(size) {}
+
+PeerMesh::~PeerMesh() { Shutdown(); }
+
+Status PeerMesh::Start() {
+  server_ = std::make_unique<TcpServer>(0);
+  if (!server_->ok()) return Status::Unknown("peer mesh: cannot listen");
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  return Status::OK();
+}
+
+int PeerMesh::port() const { return server_ ? server_->port() : 0; }
+
+void PeerMesh::SetRoster(std::vector<PeerInfo> roster) {
+  std::lock_guard<std::mutex> lock(mu_);
+  roster_ = std::move(roster);
+}
+
+void PeerMesh::AcceptLoop() {
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (shutdown_) return;
+    }
+    auto conn = server_->Accept(0.2);
+    if (!conn) continue;
+    std::vector<uint8_t> hello;
+    if (!conn->RecvFrame(hello).ok()) continue;
+    Reader r(hello);
+    int peer = r.i32();
+    conn->SetNonBlocking();
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      conns_[peer] = std::move(conn);
+    }
+    cv_.notify_all();
+  }
+}
+
+Status PeerMesh::Get(int peer, TcpConnection** out) {
+  std::unique_lock<std::mutex> lock(mu_);
+  auto it = conns_.find(peer);
+  if (it != conns_.end()) {
+    *out = it->second.get();
+    return Status::OK();
+  }
+  if (rank_ < peer) {
+    // initiator
+    if (roster_.empty() || peer >= static_cast<int>(roster_.size()))
+      return Status::Precondition("peer mesh: roster not set");
+    PeerInfo info = roster_[peer];
+    lock.unlock();
+    auto conn = TcpConnection::Connect(info.host, info.data_port, 60.0);
+    if (!conn)
+      return Status::Unknown("peer mesh: cannot connect to rank " +
+                             std::to_string(peer));
+    Writer w;
+    w.i32(rank_);
+    Status s = conn->SendFrame(w.data());
+    if (!s.ok()) return s;
+    conn->SetNonBlocking();
+    lock.lock();
+    conns_[peer] = std::move(conn);
+    *out = conns_[peer].get();
+    return Status::OK();
+  }
+  // acceptor: wait for the initiator to dial in
+  bool ok = cv_.wait_for(lock, std::chrono::seconds(60), [&] {
+    return conns_.count(peer) > 0 || shutdown_;
+  });
+  if (!ok || shutdown_)
+    return Status::Unknown("peer mesh: timeout waiting for rank " +
+                           std::to_string(peer));
+  *out = conns_[peer].get();
+  return Status::OK();
+}
+
+Status PeerMesh::SendTo(int peer, const void* data, size_t len) {
+  TcpConnection* c;
+  Status s = Get(peer, &c);
+  if (!s.ok()) return s;
+  std::vector<Transfer> ts(1);
+  ts[0] = {c->fd(), true, static_cast<const uint8_t*>(data), nullptr, len, 0};
+  return Progress(ts);
+}
+
+Status PeerMesh::RecvFrom(int peer, void* data, size_t len) {
+  TcpConnection* c;
+  Status s = Get(peer, &c);
+  if (!s.ok()) return s;
+  std::vector<Transfer> ts(1);
+  ts[0] = {c->fd(), false, nullptr, static_cast<uint8_t*>(data), len, 0};
+  return Progress(ts);
+}
+
+Status PeerMesh::SendRecv(int peer, const void* send, size_t send_len,
+                          void* recv, size_t recv_len) {
+  TcpConnection* c;
+  Status s = Get(peer, &c);
+  if (!s.ok()) return s;
+  std::vector<Transfer> ts(2);
+  ts[0] = {c->fd(), true, static_cast<const uint8_t*>(send), nullptr,
+           send_len, 0};
+  ts[1] = {c->fd(), false, nullptr, static_cast<uint8_t*>(recv), recv_len, 0};
+  return Progress(ts);
+}
+
+Status PeerMesh::RingStep(int next, int prev, const void* send,
+                          size_t send_len, void* recv, size_t recv_len) {
+  TcpConnection *cn, *cp;
+  Status s = Get(next, &cn);
+  if (!s.ok()) return s;
+  s = Get(prev, &cp);
+  if (!s.ok()) return s;
+  std::vector<Transfer> ts(2);
+  ts[0] = {cn->fd(), true, static_cast<const uint8_t*>(send), nullptr,
+           send_len, 0};
+  ts[1] = {cp->fd(), false, nullptr, static_cast<uint8_t*>(recv), recv_len,
+           0};
+  return Progress(ts);
+}
+
+void PeerMesh::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (shutdown_) return;
+    shutdown_ = true;
+  }
+  cv_.notify_all();
+  if (accept_thread_.joinable()) accept_thread_.join();
+  std::lock_guard<std::mutex> lock(mu_);
+  conns_.clear();
+  server_.reset();
+}
+
+}  // namespace hvd

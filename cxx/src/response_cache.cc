@@ -1,0 +1,102 @@
+#include "hvd/response_cache.h"
+
+namespace hvd {
+
+ResponseCache::CacheState ResponseCache::Cached(const Request& req) const {
+  auto it = entries_.find(req.tensor_name);
+  if (it == entries_.end()) return CacheState::MISS;
+  const Request& p = it->second.params;
+  if (p.type == req.type && p.dtype == req.dtype && p.shape == req.shape &&
+      p.root_rank == req.root_rank &&
+      p.prescale_factor == req.prescale_factor &&
+      p.postscale_factor == req.postscale_factor)
+    return CacheState::HIT;
+  return CacheState::INVALID;
+}
+
+void ResponseCache::Put(const Request& req, const Response& resp) {
+  auto it = entries_.find(req.tensor_name);
+  if (it != entries_.end()) {
+    it->second.response = resp;
+    it->second.params = req;
+    Touch(req.tensor_name);
+    return;
+  }
+  if (entries_.size() >= capacity_) {
+    // evict least-recently-used
+    const std::string& victim = lru_.back();
+    auto vit = entries_.find(victim);
+    free_bits_.push_back(vit->second.bit);
+    bit_to_name_.erase(vit->second.bit);
+    entries_.erase(vit);
+    lru_.pop_back();
+  }
+  uint32_t bit;
+  if (!free_bits_.empty()) {
+    bit = free_bits_.back();
+    free_bits_.pop_back();
+  } else {
+    bit = next_bit_++;
+  }
+  lru_.push_front(req.tensor_name);
+  Entry e{resp, req, bit, lru_.begin()};
+  entries_.emplace(req.tensor_name, std::move(e));
+  bit_to_name_[bit] = req.tensor_name;
+}
+
+const Response& ResponseCache::Get(const std::string& name) {
+  Touch(name);
+  return entries_.at(name).response;
+}
+
+uint32_t ResponseCache::GetBit(const std::string& name) const {
+  return entries_.at(name).bit;
+}
+
+void ResponseCache::Erase(const std::string& name) {
+  auto it = entries_.find(name);
+  if (it == entries_.end()) return;
+  free_bits_.push_back(it->second.bit);
+  bit_to_name_.erase(it->second.bit);
+  lru_.erase(it->second.lru_it);
+  entries_.erase(it);
+}
+
+void ResponseCache::Touch(const std::string& name) {
+  auto it = entries_.find(name);
+  if (it == entries_.end()) return;
+  lru_.erase(it->second.lru_it);
+  lru_.push_front(name);
+  it->second.lru_it = lru_.begin();
+}
+
+std::vector<Response> ResponseCache::ResponsesForBits(
+    const std::vector<uint64_t>& bits) const {
+  std::vector<Response> out;
+  for (size_t w = 0; w < bits.size(); ++w) {
+    uint64_t word = bits[w];
+    while (word) {
+      int b = __builtin_ctzll(word);
+      word &= word - 1;
+      uint32_t bit = static_cast<uint32_t>(w * 64 + b);
+      auto it = bit_to_name_.find(bit);
+      if (it == bit_to_name_.end()) continue;
+      out.push_back(entries_.at(it->second).response);
+    }
+  }
+  return out;
+}
+
+std::vector<uint64_t> ResponseCache::PackBits(
+    const std::vector<std::string>& names) const {
+  std::vector<uint64_t> bits(NumBitWords(), 0);
+  for (const auto& n : names) {
+    auto it = entries_.find(n);
+    if (it == entries_.end()) continue;
+    uint32_t b = it->second.bit;
+    if (b / 64 < bits.size()) bits[b / 64] |= (uint64_t{1} << (b % 64));
+  }
+  return bits;
+}
+
+}  // namespace hvd

@@ -1,0 +1,178 @@
+#include "hvd/socket.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace hvd {
+
+namespace {
+
+void SetNoDelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+TcpConnection::~TcpConnection() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+std::unique_ptr<TcpConnection> TcpConnection::Connect(const std::string& host,
+                                                      int port,
+                                                      double timeout_sec) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_sec);
+  while (true) {
+    struct addrinfo hints;
+    std::memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_str = std::to_string(port);
+    if (getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res) == 0) {
+      for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+        int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+          freeaddrinfo(res);
+          SetNoDelay(fd);
+          return std::make_unique<TcpConnection>(fd);
+        }
+        ::close(fd);
+      }
+      freeaddrinfo(res);
+    }
+    if (std::chrono::steady_clock::now() > deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+Status TcpConnection::SendFrame(const void* data, uint32_t len) {
+  uint32_t hdr = len;
+  Status s = SendRaw(&hdr, 4);
+  if (!s.ok()) return s;
+  return SendRaw(data, len);
+}
+
+Status TcpConnection::RecvFrame(std::vector<uint8_t>& out) {
+  uint32_t len = 0;
+  Status s = RecvRaw(&len, 4);
+  if (!s.ok()) return s;
+  out.resize(len);
+  if (len == 0) return Status::OK();
+  return RecvRaw(out.data(), len);
+}
+
+namespace {
+
+Status WaitReady(int fd, bool for_send) {
+  struct pollfd pfd;
+  pfd.fd = fd;
+  pfd.events = for_send ? POLLOUT : POLLIN;
+  int rv = ::poll(&pfd, 1, 120000);
+  if (rv < 0)
+    return Status::Unknown(std::string("poll failed: ") +
+                           std::strerror(errno));
+  if (rv == 0) return Status::Unknown("socket IO timed out");
+  return Status::OK();
+}
+
+}  // namespace
+
+void TcpConnection::SetNonBlocking() {
+  int flags = fcntl(fd_, F_GETFL, 0);
+  fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
+}
+
+Status TcpConnection::SendRaw(const void* data, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd_, p + sent, len - sent, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        Status s = WaitReady(fd_, true);
+        if (!s.ok()) return s;
+        continue;
+      }
+      return Status::Unknown(std::string("send failed: ") +
+                             std::strerror(errno));
+    }
+    sent += static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+Status TcpConnection::RecvRaw(void* data, size_t len) {
+  uint8_t* p = static_cast<uint8_t*>(data);
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::recv(fd_, p + got, len - got, 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        Status s = WaitReady(fd_, false);
+        if (!s.ok()) return s;
+        continue;
+      }
+      return Status::Unknown(std::string("recv failed: ") +
+                             std::strerror(errno));
+    }
+    if (n == 0) return Status::Aborted("connection closed by peer");
+    got += static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+TcpServer::TcpServer(int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) return;
+  int one = 1;
+  setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd_, reinterpret_cast<struct sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(fd_, 128) != 0) {
+    ::close(fd_);
+    fd_ = -1;
+    return;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd_, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  port_ = ntohs(addr.sin_port);
+}
+
+TcpServer::~TcpServer() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+std::unique_ptr<TcpConnection> TcpServer::Accept(double timeout_sec) {
+  struct pollfd pfd;
+  pfd.fd = fd_;
+  pfd.events = POLLIN;
+  int rv = ::poll(&pfd, 1, static_cast<int>(timeout_sec * 1000));
+  if (rv <= 0) return nullptr;
+  int cfd = ::accept(fd_, nullptr, nullptr);
+  if (cfd < 0) return nullptr;
+  int one = 1;
+  setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return std::make_unique<TcpConnection>(cfd);
+}
+
+}  // namespace hvd

@@ -1,0 +1,50 @@
+#include "hvd/stall_inspector.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <sstream>
+
+namespace hvd {
+
+bool StallInspector::Check(
+    const std::vector<std::pair<std::string, std::vector<int>>>& pending,
+    int world_size) {
+  auto now = std::chrono::steady_clock::now();
+  // prune entries that negotiated away
+  std::unordered_map<std::string, std::chrono::steady_clock::time_point>
+      kept;
+  stalled_.clear();
+  bool shutdown = false;
+  std::ostringstream warn;
+  for (const auto& p : pending) {
+    auto it = first_seen_.find(p.first);
+    auto t0 = it == first_seen_.end() ? now : it->second;
+    kept[p.first] = t0;
+    double age = std::chrono::duration<double>(now - t0).count();
+    if (age > warn_sec_) {
+      stalled_.push_back(p.first);
+      std::vector<int> missing;
+      for (int r = 0; r < world_size; ++r)
+        if (std::find(p.second.begin(), p.second.end(), r) ==
+            p.second.end())
+          missing.push_back(r);
+      warn << "  " << p.first << " [missing ranks:";
+      for (int r : missing) warn << " " << r;
+      warn << "]\n";
+    }
+    if (shutdown_sec_ > 0 && age > shutdown_sec_) shutdown = true;
+  }
+  first_seen_ = std::move(kept);
+  if (!stalled_.empty() &&
+      std::chrono::duration<double>(now - last_warn_).count() > warn_sec_) {
+    last_warn_ = now;
+    std::fprintf(stderr,
+                 "[horovod_tpu] WARNING: one or more tensors were submitted "
+                 "by a subset of ranks and are waiting on the rest for "
+                 "more than %.0f s:\n%s",
+                 warn_sec_, warn.str().c_str());
+  }
+  return shutdown;
+}
+
+}  // namespace hvd

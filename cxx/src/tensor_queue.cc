@@ -1,0 +1,51 @@
+#include "hvd/tensor_queue.h"
+
+namespace hvd {
+
+Status TensorQueue::Add(TensorTableEntry entry, const Request& req) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (table_.count(entry.name)) {
+    return Status::InvalidArgument(
+        "Duplicate tensor name " + entry.name +
+        "; a previous collective with this name is still pending");
+  }
+  pending_.push_back(req);
+  table_.emplace(entry.name, std::move(entry));
+  return Status::OK();
+}
+
+std::vector<Request> TensorQueue::PopRequests() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<Request> out(pending_.begin(), pending_.end());
+  pending_.clear();
+  return out;
+}
+
+bool TensorQueue::Take(const std::string& name, TensorTableEntry& out) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = table_.find(name);
+  if (it == table_.end()) return false;
+  out = std::move(it->second);
+  table_.erase(it);
+  return true;
+}
+
+std::vector<std::string> TensorQueue::PendingNames() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<std::string> names;
+  names.reserve(table_.size());
+  for (const auto& kv : table_) names.push_back(kv.first);
+  return names;
+}
+
+std::vector<TensorTableEntry> TensorQueue::DrainAll() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<TensorTableEntry> out;
+  out.reserve(table_.size());
+  for (auto& kv : table_) out.push_back(std::move(kv.second));
+  table_.clear();
+  pending_.clear();
+  return out;
+}
+
+}  // namespace hvd

@@ -1,0 +1,96 @@
+#include "hvd/timeline.h"
+
+namespace hvd {
+
+Timeline::~Timeline() { Shutdown(); }
+
+void Timeline::Initialize(const std::string& path, int rank) {
+  if (initialized_) return;
+  file_.open(path, std::ios::out | std::ios::trunc);
+  if (!file_.is_open()) return;
+  rank_ = rank;
+  start_ = std::chrono::steady_clock::now();
+  file_ << "[\n";
+  initialized_ = true;
+  writer_ = std::thread([this] { WriterLoop(); });
+}
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+void Timeline::Enqueue(Event e) {
+  if (!initialized_) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    queue_.push_back(std::move(e));
+  }
+  cv_.notify_one();
+}
+
+void Timeline::NegotiateStart(const std::string& name,
+                              const std::string& op) {
+  Enqueue({'B', name, "NEGOTIATE_" + op, NowUs()});
+}
+
+void Timeline::NegotiateEnd(const std::string& name) {
+  Enqueue({'E', name, "", NowUs()});
+}
+
+void Timeline::Start(const std::string& name, const std::string& op) {
+  Enqueue({'B', name, op, NowUs()});
+}
+
+void Timeline::ActivityStart(const std::string& name,
+                             const std::string& activity) {
+  Enqueue({'B', name, activity, NowUs()});
+}
+
+void Timeline::ActivityEnd(const std::string& name) {
+  Enqueue({'E', name, "", NowUs()});
+}
+
+void Timeline::End(const std::string& name) {
+  Enqueue({'E', name, "", NowUs()});
+}
+
+void Timeline::MarkCycle() { Enqueue({'i', "cycle", "CYCLE", NowUs()}); }
+
+void Timeline::WriterLoop() {
+  while (true) {
+    std::deque<Event> batch;
+    {
+      std::unique_lock<std::mutex> lock(mu_);
+      cv_.wait(lock, [this] { return !queue_.empty() || shutdown_; });
+      if (queue_.empty() && shutdown_) break;
+      batch.swap(queue_);
+    }
+    for (const Event& e : batch) {
+      if (!first_event_) file_ << ",\n";
+      first_event_ = false;
+      file_ << "{\"ph\":\"" << e.phase << "\",\"pid\":" << rank_
+            << ",\"tid\":\"" << e.tid << "\",\"ts\":" << e.ts_us;
+      if (e.phase != 'E') file_ << ",\"name\":\"" << e.label << "\"";
+      if (e.phase == 'i') file_ << ",\"s\":\"g\"";
+      file_ << "}";
+    }
+    file_.flush();
+  }
+  file_ << "\n]\n";
+  file_.close();
+}
+
+void Timeline::Shutdown() {
+  if (!initialized_) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    shutdown_ = true;
+  }
+  cv_.notify_one();
+  if (writer_.joinable()) writer_.join();
+  initialized_ = false;
+}
+
+}  // namespace hvd

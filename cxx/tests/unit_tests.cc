@@ -1,0 +1,216 @@
+// Unit tests for the pure in-process components (no sockets): message
+// round-trip, negotiator validation + fusion planning, response cache LRU,
+// stall inspector, reduction kernels. Built and run by `make test`.
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+#include "hvd/cpu_ops.h"
+#include "hvd/message.h"
+#include "hvd/negotiator.h"
+#include "hvd/response_cache.h"
+#include "hvd/stall_inspector.h"
+
+using namespace hvd;
+
+static int failures = 0;
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++failures;                                                         \
+    }                                                                     \
+  } while (0)
+
+static Request MakeReq(const std::string& name, int rank,
+                       Request::Type type = Request::ALLREDUCE,
+                       DataType dt = DataType::FLOAT32,
+                       std::vector<int64_t> dims = {4, 2}) {
+  Request q;
+  q.type = type;
+  q.request_rank = rank;
+  q.dtype = dt;
+  q.tensor_name = name;
+  q.shape = TensorShape(std::move(dims));
+  return q;
+}
+
+static void TestMessageRoundtrip() {
+  RequestList rl;
+  rl.shutdown = true;
+  Request q = MakeReq("grad/w1", 3);
+  q.prescale_factor = 0.5;
+  q.reduce_op = 1;
+  rl.requests.push_back(q);
+  auto bytes = rl.Serialize();
+  RequestList back = RequestList::Deserialize(bytes);
+  CHECK(back.shutdown);
+  CHECK(back.requests.size() == 1);
+  CHECK(back.requests[0].tensor_name == "grad/w1");
+  CHECK(back.requests[0].request_rank == 3);
+  CHECK(back.requests[0].shape.dims() == std::vector<int64_t>({4, 2}));
+  CHECK(std::abs(back.requests[0].prescale_factor - 0.5) < 1e-12);
+  CHECK(back.requests[0].reduce_op == 1);
+
+  ResponseList pl;
+  Response p;
+  p.type = Response::ALLGATHER;
+  p.tensor_names = {"a", "b"};
+  p.tensor_sizes = {1, 2, 3};
+  p.dtype = DataType::BFLOAT16;
+  p.active_ranks = 7;
+  pl.responses.push_back(p);
+  auto pb = pl.Serialize();
+  ResponseList pback = ResponseList::Deserialize(pb);
+  CHECK(pback.responses[0].tensor_names.size() == 2);
+  CHECK(pback.responses[0].tensor_sizes == std::vector<int64_t>({1, 2, 3}));
+  CHECK(pback.responses[0].dtype == DataType::BFLOAT16);
+  CHECK(pback.responses[0].active_ranks == 7);
+}
+
+static void TestNegotiatorReadiness() {
+  Negotiator n(3);
+  auto r1 = n.AddRequests({MakeReq("t", 0)}, 0);
+  CHECK(r1.empty());
+  auto r2 = n.AddRequests({MakeReq("t", 1)}, 0);
+  CHECK(r2.empty());
+  auto r3 = n.AddRequests({MakeReq("t", 2)}, 0);
+  CHECK(r3.size() == 1 && r3[0] == "t");
+  Response resp = n.BuildResponse("t");
+  CHECK(resp.type == Response::ALLREDUCE);
+  CHECK(resp.error_message.empty());
+  CHECK(resp.tensor_sizes == std::vector<int64_t>({8}));
+  CHECK(!n.has_pending());
+}
+
+static void TestNegotiatorValidation() {
+  Negotiator n(2);
+  n.AddRequests({MakeReq("t", 0, Request::ALLREDUCE, DataType::FLOAT32)}, 0);
+  auto ready = n.AddRequests(
+      {MakeReq("t", 1, Request::ALLREDUCE, DataType::FLOAT64)}, 0);
+  CHECK(ready.size() == 1);
+  Response resp = n.BuildResponse("t");
+  CHECK(resp.type == Response::ERROR);
+  CHECK(resp.error_message.find("mismatched dtypes") != std::string::npos);
+
+  // allgather with differing first dims is legal
+  Negotiator n2(2);
+  n2.AddRequests({MakeReq("g", 0, Request::ALLGATHER, DataType::FLOAT32,
+                          {2, 3})}, 0);
+  n2.AddRequests({MakeReq("g", 1, Request::ALLGATHER, DataType::FLOAT32,
+                          {5, 3})}, 0);
+  Response g = n2.BuildResponse("g");
+  CHECK(g.type == Response::ALLGATHER);
+  CHECK(g.tensor_sizes == std::vector<int64_t>({2, 5}));
+}
+
+static void TestJoinReadiness() {
+  Negotiator n(4);
+  n.AddRequests({MakeReq("t", 0)}, 0);
+  n.AddRequests({MakeReq("t", 1)}, 0);
+  // ranks 2,3 joined: readiness threshold drops to 2
+  auto ready = n.ReadyAfterJoin(2);
+  CHECK(ready.size() == 1 && ready[0] == "t");
+}
+
+static void TestFusion() {
+  auto mk = [](const std::string& name, int64_t elems,
+               DataType dt = DataType::FLOAT32) {
+    Response r;
+    r.type = Response::ALLREDUCE;
+    r.tensor_names = {name};
+    r.tensor_sizes = {elems};
+    r.dtype = dt;
+    return r;
+  };
+  // threshold 100 floats = 400 bytes
+  std::vector<Response> in = {mk("a", 50), mk("b", 40), mk("big", 200),
+                              mk("c", 8), mk("d64", 10, DataType::FLOAT64)};
+  auto out = Negotiator::Fuse(in, 400);
+  // a+b+c fuse (50+40+8=98 floats); big alone; d64 alone (dtype differs)
+  CHECK(out.size() == 3);
+  CHECK(out[0].tensor_names.size() == 3);
+  CHECK(out[0].tensor_names[2] == "c");
+  CHECK(out[1].tensor_names[0] == "big");
+  CHECK(out[2].dtype == DataType::FLOAT64);
+
+  // broadcast never fuses
+  Response bc;
+  bc.type = Response::BROADCAST;
+  bc.tensor_names = {"p"};
+  bc.tensor_sizes = {10};
+  auto out2 = Negotiator::Fuse({mk("x", 1), bc, mk("y", 1)}, 400);
+  CHECK(out2.size() == 2);  // x+y fused via look-ahead, bc alone
+}
+
+static void TestResponseCache() {
+  ResponseCache cache(2);
+  Request q1 = MakeReq("a", 0);
+  Response r1;
+  r1.tensor_names = {"a"};
+  CHECK(cache.Cached(q1) == ResponseCache::CacheState::MISS);
+  cache.Put(q1, r1);
+  CHECK(cache.Cached(q1) == ResponseCache::CacheState::HIT);
+  // same name, different shape -> INVALID
+  Request q1b = MakeReq("a", 0, Request::ALLREDUCE, DataType::FLOAT32,
+                        {9});
+  CHECK(cache.Cached(q1b) == ResponseCache::CacheState::INVALID);
+  // LRU eviction at capacity 2
+  cache.Put(MakeReq("b", 0), r1);
+  cache.Get("a");  // touch a -> b is LRU
+  cache.Put(MakeReq("c", 0), r1);
+  CHECK(cache.Cached(MakeReq("b", 0)) == ResponseCache::CacheState::MISS);
+  CHECK(cache.Cached(MakeReq("a", 0)) == ResponseCache::CacheState::HIT);
+  // bit packing round-trip
+  auto bits = cache.PackBits({"a", "c"});
+  auto resps = cache.ResponsesForBits(bits);
+  CHECK(resps.size() == 2);
+}
+
+static void TestStallInspector() {
+  StallInspector si(0.0);  // warn immediately
+  std::vector<std::pair<std::string, std::vector<int>>> pending = {
+      {"slow", {0, 2}}};
+  si.Check(pending, 4);
+  // second check: age > 0 -> stalled
+  si.Check(pending, 4);
+  CHECK(si.stalled().size() == 1);
+  CHECK(si.stalled()[0] == "slow");
+}
+
+static void TestReductionKernels() {
+  float a[4] = {1, 2, 3, 4}, b[4] = {10, 20, 30, 40};
+  ReduceInto(a, b, 4, DataType::FLOAT32, ReduceOp::SUM);
+  CHECK(a[0] == 11 && a[3] == 44);
+  ScaleInPlace(a, 4, DataType::FLOAT32, 0.5);
+  CHECK(a[0] == 5.5f);
+  int64_t ia[2] = {5, -3}, ib[2] = {2, 9};
+  ReduceInto(ia, ib, 2, DataType::INT64, ReduceOp::MAX);
+  CHECK(ia[0] == 5 && ia[1] == 9);
+  // bf16: 1.0 + 2.0 = 3.0 exactly representable
+  uint16_t ba[1] = {0x3f80}, bb[1] = {0x4000};
+  ReduceInto(ba, bb, 1, DataType::BFLOAT16, ReduceOp::SUM);
+  CHECK(ba[0] == 0x4040);
+  // fp16 roundtrip through sum
+  uint16_t ha[1] = {0x3c00}, hb[1] = {0x4000};  // 1.0, 2.0
+  ReduceInto(ha, hb, 1, DataType::FLOAT16, ReduceOp::SUM);
+  CHECK(ha[0] == 0x4200);  // 3.0
+}
+
+int main() {
+  TestMessageRoundtrip();
+  TestNegotiatorReadiness();
+  TestNegotiatorValidation();
+  TestJoinReadiness();
+  TestFusion();
+  TestResponseCache();
+  TestStallInspector();
+  TestReductionKernels();
+  if (failures == 0) {
+    std::printf("ALL CXX UNIT TESTS PASSED\n");
+    return 0;
+  }
+  std::fprintf(stderr, "%d failures\n", failures);
+  return 1;
+}

@@ -31,7 +31,6 @@ class TransformerConfig:
     num_heads: int = 8
     d_model: int = 512
     d_ff: int = 2048
-    max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     causal: bool = True
     # mesh axis the sequence dim is sharded over (ring attention), or None

@@ -84,7 +84,7 @@ def _placer(mesh, spec):
 
 
 def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
-                    batch_axes=None, donate=True):
+                    batch_axes=None, donate=True, dropout_seed=0):
     """Build a jitted SPMD classification train step.
 
     Returns ``step(state, inputs, labels) -> (state, loss)`` where
@@ -98,7 +98,11 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     data_axes = batch_axes or mesh_lib.data_axis_names(mesh)
 
     def local_step(state, inputs, labels):
-        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+        # per-step AND per-shard dropout stream (reference semantics:
+        # each rank draws independent masks)
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(dropout_seed), state.step),
+            collective.mesh_rank(data_axes))
 
         def compute_loss(params):
             variables = {"params": params}
@@ -163,11 +167,7 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
     def local_step(state, tokens):
         def compute_loss(params):
             logits = model.apply({"params": params}, tokens)
-            targets = tokens[:, 1:]
-            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-            ll = jnp.take_along_axis(logp, targets[..., None],
-                                     axis=-1)[..., 0]
-            return -jnp.mean(ll)
+            return softmax_cross_entropy(logits[:, :-1], tokens[:, 1:])
 
         loss, grads = jax.value_and_grad(compute_loss)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)

@@ -1,0 +1,182 @@
+"""Worker process for native-core tests (spawned by test_native_core.py).
+
+Mirrors the reference's test execution model (SURVEY.md §4: the same test
+body runs in N processes and differentiates on rank) — but spawned by our
+own harness instead of mpirun. Usage:
+    python native_worker.py <scenario> <rank> <size> <port>
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from horovod_tpu import _core as core  # noqa: E402
+
+
+def adasum_combine(a, b):
+    dot = float(a @ b)
+    na = float(a @ a)
+    nb = float(b @ b)
+    ca = 1.0 - dot / (2 * na) if na > 0 else 1.0
+    cb = 1.0 - dot / (2 * nb) if nb > 0 else 1.0
+    return ca * a + cb * b
+
+
+def adasum_ref(vectors):
+    """NumPy reference for the recursive-halving schedule (the
+    test_adasum_tensorflow.py:33-63 pattern)."""
+    vs = list(vectors)
+    while len(vs) > 1:
+        vs = [adasum_combine(vs[i], vs[i + 1]) for i in range(0, len(vs), 2)]
+    return vs[0]
+
+
+def scenario_collectives(rank, size):
+    # -- allreduce average, fp32
+    x = np.arange(8, dtype=np.float32) + rank
+    out = core.allreduce(x, "ar.avg", op="average")
+    expected = np.arange(8, dtype=np.float32) + (size - 1) / 2.0
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+    # -- allreduce sum, int64
+    xi = np.full((3, 2), rank + 1, dtype=np.int64)
+    out = core.allreduce(xi, "ar.sum", op="sum")
+    np.testing.assert_array_equal(out, np.full((3, 2),
+                                               size * (size + 1) // 2))
+
+    # -- min / max
+    xm = np.array([rank, -rank], dtype=np.float32)
+    np.testing.assert_allclose(core.allreduce(xm, "ar.min", op="min"),
+                               [0, -(size - 1)])
+    np.testing.assert_allclose(core.allreduce(xm, "ar.max", op="max"),
+                               [size - 1, 0])
+
+    # -- float16 path
+    xh = (np.ones(5) * (rank + 1)).astype(np.float16)
+    out = core.allreduce(xh, "ar.f16", op="sum")
+    np.testing.assert_allclose(out.astype(np.float32),
+                               np.ones(5) * size * (size + 1) / 2)
+
+    # -- fused batch: many small tensors in flight at once
+    handles = [core.allreduce_async(
+        np.full(4, rank + i, dtype=np.float32), f"fuse.{i}", op="average")
+        for i in range(20)]
+    for i, h in enumerate(handles):
+        np.testing.assert_allclose(h.wait(),
+                                   np.full(4, (size - 1) / 2.0 + i),
+                                   rtol=1e-6)
+
+    # -- allgatherv: rank r contributes r+1 rows
+    xg = np.full((rank + 1, 3), rank, dtype=np.float32)
+    out = core.allgather(xg, "ag.v")
+    expected = np.concatenate(
+        [np.full((r + 1, 3), r, dtype=np.float32) for r in range(size)])
+    np.testing.assert_array_equal(out, expected)
+
+    # -- broadcast from root 1
+    xb = np.full(6, rank * 10, dtype=np.float64)
+    out = core.broadcast(xb, "bc.1", root_rank=1)
+    np.testing.assert_array_equal(out, np.full(6, 10.0))
+
+    # -- alltoall
+    xa = np.arange(size * 2, dtype=np.int32) + 100 * rank
+    out = core.alltoall(xa, "a2a")
+    expected = np.concatenate(
+        [np.arange(rank * 2, rank * 2 + 2, dtype=np.int32) + 100 * r
+         for r in range(size)])
+    np.testing.assert_array_equal(out, expected)
+
+    # -- barrier
+    core.barrier()
+
+    # -- prescale/postscale
+    xs = np.ones(4, dtype=np.float32) * (rank + 1)
+    out = core.allreduce(xs, "ar.scaled", op="sum", prescale=2.0,
+                         postscale=0.5)
+    np.testing.assert_allclose(out, np.ones(4) * size * (size + 1) / 2)
+
+
+def scenario_adasum(rank, size):
+    rng = np.random.default_rng(7)
+    grads = [rng.standard_normal(33).astype(np.float32)
+             for _ in range(size)]
+    out = core.allreduce(grads[rank], "adasum.0", op="adasum")
+    expected = adasum_ref(grads)
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def scenario_errors(rank, size):
+    # shape mismatch across ranks -> negotiated error on every rank
+    x = np.ones(4 + rank, dtype=np.float32)
+    try:
+        core.allreduce(x, "err.shape")
+        raise SystemExit("expected shape-mismatch error")
+    except RuntimeError as e:
+        assert "mismatched shapes" in str(e), str(e)
+
+    # dtype mismatch
+    x = (np.ones(4, dtype=np.float32) if rank % 2 == 0
+         else np.ones(4, dtype=np.float64))
+    try:
+        core.allreduce(x, "err.dtype")
+        raise SystemExit("expected dtype-mismatch error")
+    except RuntimeError as e:
+        assert "mismatched dtypes" in str(e), str(e)
+
+    # duplicate name while pending: enqueue two with the same name
+    # without waiting (second must fail)
+    h1 = core.allreduce_async(np.ones(4, np.float32), "err.dup")
+    h2 = core.allreduce_async(np.ones(4, np.float32), "err.dup")
+    try:
+        h2.wait()
+        raise SystemExit("expected duplicate-name error")
+    except RuntimeError as e:
+        assert "Duplicate" in str(e), str(e)
+    h1.wait()
+    core.barrier()
+
+
+def scenario_join(rank, size):
+    # all ranks do one allreduce; then ranks >= 2 run out of data and join
+    # while 0,1 do one more averaged allreduce (over active ranks only)
+    x = np.ones(4, dtype=np.float32) * (rank + 1)
+    core.allreduce(x, "join.step0", op="average")
+    if rank >= 2:
+        core.join()
+    else:
+        out = core.allreduce(x, "join.step1", op="average")
+        np.testing.assert_allclose(out, np.ones(4) * 1.5)  # mean of 1,2
+        core.join()
+
+
+def scenario_timeline(rank, size):
+    x = np.ones(4, dtype=np.float32)
+    core.allreduce(x, "tl.a", op="sum")
+    core.allreduce(x, "tl.b", op="average")
+    core.barrier()
+
+
+def main():
+    scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
+                                  int(sys.argv[3]), int(sys.argv[4]))
+    core.init(rank=rank, size=size, coord_host="127.0.0.1",
+              coord_port=port)
+    try:
+        globals()[f"scenario_{scenario}"](rank, size)
+    finally:
+        core.shutdown()
+    if scenario == "timeline" and rank == 0:
+        path = os.environ["HOROVOD_TIMELINE"]
+        with open(path) as f:
+            events = json.load(f)
+        assert any(e.get("name", "").startswith("NEGOTIATE") for e in events)
+        assert any(e["tid"] == "tl.a" for e in events)
+    print(f"worker {rank} ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Native core (cxx/) end-to-end tests: real localhost processes.
+
+Reference strategy (SURVEY.md §4): collectives are tested multi-process on
+localhost, never mocked. Here the harness spawns N python workers itself
+(no mpirun on TPU VMs — that's the point of the TCP control plane)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+WORKER = os.path.join(os.path.dirname(__file__), "native_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_workers(scenario, size, env_extra=None, timeout=90):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("HOROVOD_TIMELINE", None)
+    env["JAX_PLATFORMS"] = "cpu"  # workers never need a device
+    env.update(env_extra or {})
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, scenario, str(r), str(size), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for r in range(size)
+    ]
+    results = [p.communicate(timeout=timeout) for p in procs]
+    for r, (p, (out, err)) in enumerate(zip(procs, results)):
+        assert p.returncode == 0, (
+            f"rank {r} failed (rc={p.returncode}):\n{out}\n{err}")
+    return results
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_collectives(size):
+    _run_workers("collectives", size)
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_adasum_matches_numpy_reference(size):
+    _run_workers("adasum", size)
+
+
+def test_errors_negotiated(tmp_path):
+    _run_workers("errors", 2)
+
+
+def test_join_uneven_ranks():
+    _run_workers("join", 4)
+
+
+def test_timeline_written(tmp_path):
+    tl = str(tmp_path / "timeline.json")
+    _run_workers("timeline", 2, env_extra={"HOROVOD_TIMELINE": tl})
+    assert os.path.exists(tl)
+
+
+def test_single_process_local():
+    """size=1: everything is a local no-op (Horovod semantics)."""
+    sys.path.insert(0, REPO)
+    from horovod_tpu import _core as core
+    core.init(rank=0, size=1)
+    try:
+        x = np.arange(5, dtype=np.float32)
+        np.testing.assert_array_equal(core.allreduce(x, "sp.a"), x)
+        np.testing.assert_array_equal(core.allgather(x, "sp.b"), x)
+        np.testing.assert_array_equal(core.broadcast(x, "sp.c"), x)
+        core.barrier()
+    finally:
+        core.shutdown()
+
+
+def test_cxx_unit_tests():
+    """The in-process C++ component tests (message/negotiator/cache/...)."""
+    rv = subprocess.run(["make", "-C", os.path.join(REPO, "cxx"), "test"],
+                        capture_output=True, text=True)
+    assert rv.returncode == 0, rv.stdout + rv.stderr
+    assert "ALL CXX UNIT TESTS PASSED" in rv.stdout
