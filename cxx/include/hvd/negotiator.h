@@ -32,6 +32,11 @@ class Negotiator {
   // clear its state.
   Response BuildResponse(const std::string& name);
 
+  // First rank's request for a pending tensor (cache key), or nullptr.
+  const Request* FirstRequest(const std::string& name) const;
+  // Clear a tensor's state without building (cache-hit fast path).
+  void Drop(const std::string& name);
+
   // Fuse compatible responses: same type, same dtype, no errors,
   // cumulative payload <= threshold bytes. Allreduce/Adasum only —
   // allgather/broadcast go out one-per-tensor. Order preserved with

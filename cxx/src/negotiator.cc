@@ -153,6 +153,19 @@ std::vector<Response> Negotiator::Fuse(std::vector<Response> responses,
   return out;
 }
 
+const Request* Negotiator::FirstRequest(const std::string& name) const {
+  auto it = message_table_.find(name);
+  if (it == message_table_.end() || it->second.empty()) return nullptr;
+  return &it->second[0];
+}
+
+void Negotiator::Drop(const std::string& name) {
+  message_table_.erase(name);
+  arrival_order_.erase(
+      std::remove(arrival_order_.begin(), arrival_order_.end(), name),
+      arrival_order_.end());
+}
+
 std::vector<std::pair<std::string, std::vector<int>>> Negotiator::Pending()
     const {
   std::vector<std::pair<std::string, std::vector<int>>> out;

@@ -42,11 +42,21 @@ _lib = None
 
 def build(force=False):
     """Build libhvdcore.so from cxx/ (the reference's setup.py build step,
-    here a plain make)."""
+    here a plain make). File-locked: concurrently launched ranks must not
+    run make into the same build dir at once."""
     if os.path.exists(_LIB_PATH) and not force:
         return _LIB_PATH
-    subprocess.run(["make", "-C", os.path.abspath(_CXX_DIR), "-j"],
-                   check=True, capture_output=True)
+    import fcntl
+    lock_path = os.path.join(os.path.dirname(__file__), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(_LIB_PATH) and not force:  # built while waiting
+                return _LIB_PATH
+            subprocess.run(["make", "-C", os.path.abspath(_CXX_DIR), "-j"],
+                           check=True, capture_output=True)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
     return _LIB_PATH
 
 

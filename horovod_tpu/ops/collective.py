@@ -172,10 +172,26 @@ def alltoall(x, axes=None):
 # run when the user calls hvd.allreduce(...) at top level with a local array
 # (the reference's eager op path, e.g. horovod/torch/mpi_ops.py
 # allreduce_async + synchronize). With one launched process they are local
-# no-ops by Horovod semantics (world size 1). With multiple processes each
-# process contributes its local value; we stage it onto this process's
-# devices and run one compiled global reduction.
+# no-ops by Horovod semantics (world size 1). Under hvdrun, the native core
+# (TCP ring collectives, horovod_tpu._core) carries them; in a
+# jax.distributed job without the core, a compiled global reduction over
+# the process mesh does.
 # ---------------------------------------------------------------------------
+
+_EAGER_COUNTERS = {}
+
+
+def _eager_name(kind):
+    n = _EAGER_COUNTERS.get(kind, 0)
+    _EAGER_COUNTERS[kind] = n + 1
+    return f"eager.{kind}.{n}"
+
+
+def _native_core():
+    from horovod_tpu import _core
+    if _core.is_initialized():
+        return _core
+    return None
 
 
 def _num_processes():
@@ -202,6 +218,10 @@ def _stage_global(x):
 
 def _eager_allreduce(x, op, axes):
     del axes
+    core = _native_core()
+    if core is not None:
+        return jnp.asarray(core.allreduce(np.asarray(x),
+                                          _eager_name("allreduce"), op=op))
     nproc = _num_processes()
     if nproc == 1:
         return jnp.asarray(x)
@@ -225,6 +245,10 @@ def _eager_allreduce(x, op, axes):
 
 def _eager_allgather(x, axes):
     del axes
+    core = _native_core()
+    if core is not None:
+        return jnp.asarray(core.allgather(np.asarray(x),
+                                          _eager_name("allgather")))
     nproc = _num_processes()
     if nproc == 1:
         return jnp.asarray(x)
@@ -241,6 +265,11 @@ def _eager_allgather(x, axes):
 
 def _eager_broadcast(x, root_rank, axes):
     del axes
+    core = _native_core()
+    if core is not None:
+        return jnp.asarray(core.broadcast(np.asarray(x),
+                                          _eager_name("broadcast"),
+                                          root_rank=root_rank))
     nproc = _num_processes()
     if nproc == 1:
         return jnp.asarray(x)

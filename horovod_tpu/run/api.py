@@ -1,0 +1,64 @@
+"""Programmatic launcher: ``run(fn, args=(), np=N)``.
+
+Rebuilds the reference's interactive API (``horovod.run.run()``,
+``horovod/run/run.py:857-953``): pickle a function, ship it to N freshly
+launched worker processes through the KV server, execute it under the full
+env contract, collect per-rank results in rank order.
+"""
+
+import os
+import pickle
+import sys
+
+from horovod_tpu.run import allocation, launcher
+from horovod_tpu.run.rendezvous import KVStoreServer, kv_wait
+
+try:  # cloudpickle handles closures/lambdas; stdlib pickle is the fallback
+    import cloudpickle as _pickler
+except ImportError:  # pragma: no cover
+    _pickler = pickle
+
+
+def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
+        timeout=300, use_jax_coordinator=False):
+    """Run ``fn(*args, **kwargs)`` in ``np`` horovod_tpu processes and
+    return the list of per-rank return values (rank order)."""
+    kwargs = kwargs or {}
+    host_list = (allocation.parse_hosts(hosts) if hosts
+                 else [allocation.HostSlots("localhost", np)])
+    slots = allocation.allocate(host_list, np)
+
+    controller_addr = slots[0].hostname
+    if controller_addr in launcher.LOCAL_HOSTS:
+        controller_addr = "127.0.0.1"
+    controller_port = 0  # rank 0 binds + publishes via the KV server
+
+    kv = KVStoreServer()
+    rendezvous_port = kv.start()
+    kv.put("runfunc/func", _pickler.dumps((fn, args, kwargs)))
+
+    env = dict(extra_env or {})
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                      os.pardir, os.pardir))] +
+        os.environ.get("PYTHONPATH", "").split(os.pathsep))
+    if use_jax_coordinator:
+        env["HOROVOD_COORDINATOR_ADDR"] = (
+            f"{controller_addr}:{free_port()}")
+
+    command = [sys.executable, "-m", "horovod_tpu.run.run_task"]
+    job = launcher.launch(slots, command, controller_addr, controller_port,
+                          rendezvous_port=rendezvous_port, extra_env=env)
+    try:
+        job.wait()
+        results = []
+        for r in range(np):
+            payload = kv_wait("127.0.0.1", rendezvous_port,
+                              f"runfunc/result/{r}", timeout=timeout)
+            ok, value = pickle.loads(payload)
+            if not ok:
+                raise RuntimeError(f"rank {r} raised: {value}")
+            results.append(value)
+        return results
+    finally:
+        kv.stop()

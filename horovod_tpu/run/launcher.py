@@ -1,0 +1,147 @@
+"""Process spawning, monitoring, and failure fan-out.
+
+Rebuilds ``horovod/run/gloo_run.py:142-288`` (``_launch_jobs``): one
+process per slot with the env contract, local slots via subprocess,
+remote slots via ssh; a monitor thread per process; any non-zero exit
+kills the whole job; SIGINT/SIGTERM fan out to every child.
+"""
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+
+LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def slot_env(slot, controller_addr, controller_port, rendezvous_addr=None,
+             rendezvous_port=None, extra_env=None):
+    """The worker env contract (reference gloo_run.py:210-236,
+    gloo_context.cc:41-50)."""
+    env = {
+        "HOROVOD_RANK": str(slot.rank),
+        "HOROVOD_SIZE": str(slot.size),
+        "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+        "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+        "HOROVOD_CONTROLLER_ADDR": controller_addr,
+        "HOROVOD_CONTROLLER_PORT": str(controller_port),
+        "HOROVOD_HOSTNAME": slot.hostname,
+    }
+    if rendezvous_addr is not None:
+        env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = rendezvous_addr
+        env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(rendezvous_port)
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+def build_command(slot, command, env, ssh_port=None, cwd=None):
+    """Local slots exec the command directly; remote slots wrap it in ssh
+    with inline env exports (reference gloo_run.py:262-288)."""
+    if slot.hostname in LOCAL_HOSTS:
+        return command, env  # merged with os.environ by the spawner
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    remote_cwd = cwd or os.getcwd()
+    remote = (f"cd {shlex.quote(remote_cwd)} && env {exports} " +
+              " ".join(shlex.quote(c) for c in command))
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    ssh += [slot.hostname, remote]
+    return ssh, {}
+
+
+class Job:
+    """A running multi-process job."""
+
+    def __init__(self):
+        self.procs = []
+        self._failed = threading.Event()
+        self.first_failure = None
+        self._lock = threading.Lock()
+
+    def kill_all(self, sig=signal.SIGTERM):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+
+    def _monitor(self, rank, proc):
+        rc = proc.wait()
+        if rc != 0 and not self._failed.is_set():
+            with self._lock:
+                if self.first_failure is None:
+                    self.first_failure = (rank, rc)
+            self._failed.set()
+            self.kill_all()
+
+    def wait(self):
+        """Block until all processes exit; raise on any failure
+        (reference gloo_run.py:253-259)."""
+        threads = [threading.Thread(target=self._monitor, args=(r, p))
+                   for r, p in enumerate(self.procs)]
+        for t in threads:
+            t.start()
+        try:
+            for t in threads:
+                t.join()
+        except KeyboardInterrupt:
+            self.kill_all(signal.SIGINT)
+            for t in threads:
+                t.join()
+            raise
+        if self.first_failure is not None:
+            rank, rc = self.first_failure
+            raise RuntimeError(
+                f"hvdrun: process with rank {rank} exited with code {rc}; "
+                f"remaining processes were terminated")
+
+
+def launcher_addr(slots):
+    """Address where workers can reach services running on the LAUNCHER
+    machine (the KV/rendezvous server): loopback for all-local jobs, this
+    host's address otherwise."""
+    import socket
+    if all(s.hostname in LOCAL_HOSTS for s in slots):
+        return "127.0.0.1"
+    return socket.gethostbyname(socket.gethostname())
+
+
+def launch(slots, command, controller_addr, controller_port,
+           rendezvous_addr=None, rendezvous_port=None, extra_env=None,
+           ssh_port=None, stdout=None, output_dir=None):
+    """Spawn one process per slot and return a Job."""
+    job = Job()
+    if rendezvous_port is not None and rendezvous_addr is None:
+        rendezvous_addr = launcher_addr(slots)
+    for slot in slots:
+        env = slot_env(slot, controller_addr, controller_port,
+                       rendezvous_addr=rendezvous_addr,
+                       rendezvous_port=rendezvous_port, extra_env=extra_env)
+        cmd, proc_env = build_command(slot, command, env, ssh_port=ssh_port)
+        full_env = dict(os.environ)
+        full_env.update(proc_env if cmd[0] == "ssh" else env)
+        out = stdout
+        if output_dir:
+            os.makedirs(output_dir, exist_ok=True)
+            out = open(os.path.join(output_dir, f"rank.{slot.rank}.log"),
+                       "wb")
+        job.procs.append(subprocess.Popen(
+            cmd, env=full_env, stdout=out,
+            stderr=subprocess.STDOUT if out else None))
+    # fan out SIGINT/SIGTERM (only from the main thread of the CLI)
+    if threading.current_thread() is threading.main_thread():
+        def _forward(signum, frame):
+            job.kill_all(signum)
+            sys.exit(128 + signum)
+        try:
+            signal.signal(signal.SIGTERM, _forward)
+        except ValueError:
+            pass
+    return job

@@ -1,0 +1,116 @@
+"""HTTP rendezvous / KV store server.
+
+Rebuilds ``horovod/run/http/http_server.py`` (RendezvousServer /
+KVStoreServer): an in-memory key-value store over HTTP GET/PUT/DELETE,
+scoped by path (``/scope/key``). Used by the launcher to pass pickled
+functions and collect results (``horovod.run.run()`` pattern) and
+available to external tooling as a rendezvous point. GET on a missing key
+returns 404 so clients can poll (reference http_server.py:40-60).
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store = None  # class attribute set by the server
+    lock = None
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _key(self):
+        return self.path.lstrip("/")
+
+    def do_GET(self):
+        with self.lock:
+            val = self.store.get(self._key())
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        with self.lock:
+            self.store[self._key()] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_DELETE(self):
+        with self.lock:
+            self.store.pop(self._key(), None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVStoreServer:
+    """Threaded HTTP KV server; ``port=0`` binds an ephemeral port."""
+
+    def __init__(self, port=0):
+        handler = type("Handler", (_Handler,),
+                       {"store": {}, "lock": threading.Lock()})
+        self._handler_cls = handler
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join()
+
+    # direct access for in-process use
+    def get(self, key):
+        with self._handler_cls.lock:
+            return self._handler_cls.store.get(key)
+
+    def put(self, key, value):
+        with self._handler_cls.lock:
+            self._handler_cls.store[key] = value
+
+
+def kv_get(addr, port, key, timeout=5.0):
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://{addr}:{port}/{key}", timeout=timeout) as r:
+            return r.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def kv_put(addr, port, key, value):
+    import urllib.request
+    req = urllib.request.Request(f"http://{addr}:{port}/{key}",
+                                 data=value, method="PUT")
+    urllib.request.urlopen(req, timeout=5.0).read()
+
+
+def kv_wait(addr, port, key, timeout=60.0, poll=0.1):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = kv_get(addr, port, key)
+        if v is not None:
+            return v
+        time.sleep(poll)
+    raise TimeoutError(f"key {key} not published within {timeout}s")

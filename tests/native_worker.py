@@ -153,6 +153,22 @@ def scenario_join(rank, size):
         core.join()
 
 
+def scenario_join_allgather(rank, size):
+    # allgather after a rank joined must fail cleanly on every active rank
+    # (reference restriction controller.cc:443-447)
+    if rank >= size - 1:
+        core.join()
+    else:
+        import time
+        time.sleep(0.3)  # let the join land first
+        try:
+            core.allgather(np.ones((2, 2), np.float32), "jag.x")
+            raise SystemExit("expected join+allgather error")
+        except RuntimeError as e:
+            assert "not supported after a rank has joined" in str(e), str(e)
+        core.join()
+
+
 def scenario_timeline(rank, size):
     x = np.ones(4, dtype=np.float32)
     core.allreduce(x, "tl.a", op="sum")

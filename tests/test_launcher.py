@@ -1,0 +1,195 @@
+"""Launcher tests (reference: test/test_run.py — parsing/allocation/env
+construction as unit tests, plus a real interactive-run end-to-end like
+test/test_interactiverun.py)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.run import allocation, api, config_parser, launcher
+from horovod_tpu.run.run import parse_args
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+# ---- allocation (reference gloo_run.py:53-111) -------------------------
+
+def test_parse_hosts():
+    hosts = allocation.parse_hosts("h1:4,h2:2,h3")
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("h1", 4), ("h2", 2), ("h3", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    p = tmp_path / "hosts"
+    p.write_text("h1 slots=4\n# comment\nh2 slots=2\nh3\n")
+    hosts = allocation.parse_hostfile(str(p))
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("h1", 4), ("h2", 2), ("h3", 1)]
+
+
+def test_allocate_two_hosts():
+    slots = allocation.allocate(allocation.parse_hosts("h1:2,h2:2"), 4)
+    assert [(s.rank, s.hostname, s.local_rank, s.local_size,
+             s.cross_rank, s.cross_size) for s in slots] == [
+        (0, "h1", 0, 2, 0, 2), (1, "h1", 1, 2, 0, 2),
+        (2, "h2", 0, 2, 1, 2), (3, "h2", 1, 2, 1, 2)]
+
+
+def test_allocate_uneven():
+    slots = allocation.allocate(allocation.parse_hosts("h1:3,h2:1"), 4)
+    by_rank = {s.rank: s for s in slots}
+    assert by_rank[2].hostname == "h1" and by_rank[2].local_rank == 2
+    # local_rank 2 exists only on h1 -> cross_size 1
+    assert by_rank[2].cross_size == 1
+    # local_rank 0 exists on both hosts
+    assert by_rank[0].cross_size == 2 and by_rank[3].cross_rank == 1
+
+
+def test_allocate_too_many():
+    with pytest.raises(ValueError, match="only 2 slots"):
+        allocation.allocate(allocation.parse_hosts("h1:2"), 3)
+
+
+# ---- CLI / env mapping (reference test_run.py:68-233) ------------------
+
+def test_args_to_env():
+    args = parse_args(["-np", "2", "--fusion-threshold-mb", "32",
+                       "--cycle-time-ms", "2.5", "--autotune",
+                       "--timeline-filename", "/tmp/t.json",
+                       "python", "train.py"])
+    env = config_parser.args_to_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+    assert args.command == ["python", "train.py"]
+
+
+def test_config_file(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""
+        fusion-threshold-mb: 16
+        autotune: true
+        stall-warning-time-seconds: 30
+    """))
+    args = parse_args(["-np", "2", "--config-file", str(cfg),
+                       "--fusion-threshold-mb", "8",  # CLI wins
+                       "python", "x.py"])
+    env = config_parser.args_to_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(8 * 1024 * 1024)
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "30"
+
+
+def test_slot_env_contract():
+    slot = allocation.Slot(rank=3, hostname="h2", local_rank=1,
+                           local_size=2, cross_rank=1, cross_size=2, size=4)
+    env = launcher.slot_env(slot, "10.0.0.1", 9999,
+                            rendezvous_addr="10.0.0.1",
+                            rendezvous_port=8888)
+    assert env["HOROVOD_RANK"] == "3"
+    assert env["HOROVOD_LOCAL_RANK"] == "1"
+    assert env["HOROVOD_CROSS_RANK"] == "1"
+    assert env["HOROVOD_CONTROLLER_ADDR"] == "10.0.0.1"
+    assert env["HOROVOD_GLOO_RENDEZVOUS_PORT"] == "8888"
+
+
+def test_build_command_ssh():
+    slot = allocation.Slot(rank=2, hostname="remotehost", local_rank=0,
+                           local_size=2, cross_rank=1, cross_size=2, size=4)
+    cmd, env = launcher.build_command(
+        slot, ["python", "train.py"], {"HOROVOD_RANK": "2"}, ssh_port=2222)
+    assert cmd[0] == "ssh"
+    assert "-p" in cmd and "2222" in cmd
+    assert cmd[-2] == "remotehost"
+    assert "HOROVOD_RANK=2" in cmd[-1] and "python train.py" in cmd[-1]
+    assert env == {}
+
+
+def test_build_command_local():
+    slot = allocation.Slot(rank=0, hostname="localhost", local_rank=0,
+                           local_size=1, cross_rank=0, cross_size=1, size=1)
+    cmd, env = launcher.build_command(slot, ["python", "t.py"],
+                                      {"HOROVOD_RANK": "0"})
+    assert cmd == ["python", "t.py"]
+    assert env["HOROVOD_RANK"] == "0"
+
+
+# ---- end-to-end (reference test_interactiverun.py) ---------------------
+
+def test_programmatic_run():
+    def hvd_fn(scale):
+        import numpy as np
+
+        import horovod_tpu as hvd
+        hvd.init()
+        x = np.ones(4, dtype=np.float32) * (hvd.rank() + 1) * scale
+        out = hvd.allreduce(x, op=hvd.Average)
+        return float(np.asarray(out)[0]), hvd.rank(), hvd.size()
+
+    results = api.run(hvd_fn, args=(2.0,), np=3,
+                      extra_env={"JAX_PLATFORMS": "cpu"})
+    vals = [v for v, _, _ in results]
+    ranks = [r for _, r, _ in results]
+    # mean of 2,4,6 = 4.0 on every rank
+    np.testing.assert_allclose(vals, [4.0] * 3)
+    assert ranks == [0, 1, 2]
+    assert all(s == 3 for _, _, s in results)
+
+
+def test_programmatic_run_failure():
+    def bad(_):
+        raise ValueError("boom on purpose")
+
+    with pytest.raises(RuntimeError):
+        api.run(bad, args=(1,), np=2,
+                extra_env={"JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_end_to_end(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        x = np.ones(3, dtype=np.float32) * (hvd.rank() + 1)
+        out = hvd.allreduce(x, op=hvd.Sum)
+        assert np.allclose(np.asarray(out), 3.0), out  # 1+2
+        g = hvd.allgather(np.array([hvd.rank()], dtype=np.int32))
+        assert list(np.asarray(g)) == [0, 1], g
+        print(f"rank {hvd.rank()} OK")
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rv = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert rv.returncode == 0, rv.stdout + rv.stderr
+
+
+def test_cli_failure_kills_job(tmp_path):
+    script = tmp_path / "crash.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        import horovod_tpu as hvd
+        hvd.init()
+        if hvd.rank() == 1:
+            sys.exit(3)
+        time.sleep(60)  # would hang forever without failure fan-out
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rv = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=90)
+    assert rv.returncode == 1
+    assert "exited with code 3" in rv.stderr

@@ -62,6 +62,10 @@ def test_join_uneven_ranks():
     _run_workers("join", 4)
 
 
+def test_join_rejects_allgather():
+    _run_workers("join_allgather", 3)
+
+
 def test_timeline_written(tmp_path):
     tl = str(tmp_path / "timeline.json")
     _run_workers("timeline", 2, env_extra={"HOROVOD_TIMELINE": tl})
