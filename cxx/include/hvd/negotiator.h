@@ -34,6 +34,9 @@ class Negotiator {
 
   // First rank's request for a pending tensor (cache key), or nullptr.
   const Request* FirstRequest(const std::string& name) const;
+  // ALL ranks' requests for a pending tensor (cache validation needs
+  // every rank's view, not just the first arrival's), or nullptr.
+  const std::vector<Request>* Requests(const std::string& name) const;
   // Clear a tensor's state without building (cache-hit fast path).
   void Drop(const std::string& name);
 

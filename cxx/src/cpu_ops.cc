@@ -40,10 +40,12 @@ inline uint16_t FloatToHalf(float x) {
   uint32_t f;
   std::memcpy(&f, &x, 4);
   uint32_t sign = (f >> 16) & 0x8000u;
-  int32_t exp = static_cast<int32_t>((f >> 23) & 0xff) - 127 + 15;
+  uint32_t src_exp = (f >> 23) & 0xffu;
+  int32_t exp = static_cast<int32_t>(src_exp) - 127 + 15;
   uint32_t mant = f & 0x7fffffu;
-  if (exp >= 31)  // inf, and NaN keeps a mantissa bit
+  if (src_exp == 0xffu)  // source inf/NaN; NaN keeps a mantissa bit
     return static_cast<uint16_t>(sign | 0x7c00u | (mant ? 0x200u : 0u));
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7c00u);  // overflow
   if (exp <= 0) {
     if (exp < -10) return static_cast<uint16_t>(sign);
     mant |= 0x800000u;

@@ -38,10 +38,7 @@ Response Negotiator::BuildResponse(const std::string& name) {
     return resp;
   }
   std::vector<Request> reqs = std::move(it->second);
-  message_table_.erase(it);
-  arrival_order_.erase(
-      std::remove(arrival_order_.begin(), arrival_order_.end(), name),
-      arrival_order_.end());
+  Drop(name);
 
   const Request& first = reqs[0];
   resp.dtype = first.dtype;
@@ -157,6 +154,13 @@ const Request* Negotiator::FirstRequest(const std::string& name) const {
   auto it = message_table_.find(name);
   if (it == message_table_.end() || it->second.empty()) return nullptr;
   return &it->second[0];
+}
+
+const std::vector<Request>* Negotiator::Requests(
+    const std::string& name) const {
+  auto it = message_table_.find(name);
+  if (it == message_table_.end()) return nullptr;
+  return &it->second;
 }
 
 void Negotiator::Drop(const std::string& name) {

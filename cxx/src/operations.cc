@@ -343,19 +343,29 @@ ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
     // steady-state fast path: identical-parameter repeats reuse the cached
     // validated response (reference response_cache.h:45-102; the
     // bitvector short-circuit of the full protocol maps onto our
-    // synchronous rounds as a validation skip)
-    const Request* first = g->negotiator.FirstRequest(name);
-    if (first != nullptr &&
-        g->cache.Cached(*first) == ResponseCache::CacheState::HIT) {
+    // synchronous rounds as a validation skip). A HIT requires EVERY
+    // rank's request to match the cached params — checking one rank would
+    // skip the cross-rank agreement guarantee.
+    const std::vector<Request>* reqs = g->negotiator.Requests(name);
+    bool all_hit = reqs != nullptr && !reqs->empty();
+    if (all_hit)
+      for (const Request& q : *reqs)
+        if (g->cache.Cached(q) != ResponseCache::CacheState::HIT) {
+          all_hit = false;
+          break;
+        }
+    if (all_hit) {
       r = g->cache.Get(name);
       g->negotiator.Drop(name);
     } else {
-      Request params = first != nullptr ? *first : Request{};
-      if (first != nullptr &&
-          g->cache.Cached(*first) == ResponseCache::CacheState::INVALID)
-        g->cache.Erase(name);
+      Request params =
+          (reqs && !reqs->empty()) ? (*reqs)[0] : Request{};
+      g->cache.Erase(name);  // params changed (or never cached)
       r = g->negotiator.BuildResponse(name);
-      if (r.type != Response::ERROR) g->cache.Put(params, r);
+      // allgather responses embed per-rank dims that may change step to
+      // step; never cache them
+      if (r.type != Response::ERROR && r.type != Response::ALLGATHER)
+        g->cache.Put(params, r);
     }
     r.active_ranks = active;
     // allgather/broadcast/alltoall cannot zero-fill for joined ranks

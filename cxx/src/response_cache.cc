@@ -7,7 +7,7 @@ ResponseCache::CacheState ResponseCache::Cached(const Request& req) const {
   if (it == entries_.end()) return CacheState::MISS;
   const Request& p = it->second.params;
   if (p.type == req.type && p.dtype == req.dtype && p.shape == req.shape &&
-      p.root_rank == req.root_rank &&
+      p.root_rank == req.root_rank && p.reduce_op == req.reduce_op &&
       p.prescale_factor == req.prescale_factor &&
       p.postscale_factor == req.postscale_factor)
     return CacheState::HIT;

@@ -33,16 +33,19 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
         controller_addr = "127.0.0.1"
     controller_port = 0  # rank 0 binds + publishes via the KV server
 
-    kv = KVStoreServer()
+    all_local = all(s.hostname in launcher.LOCAL_HOSTS for s in slots)
+    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0")
     rendezvous_port = kv.start()
     kv.put("runfunc/func", _pickler.dumps((fn, args, kwargs)))
 
     env = dict(extra_env or {})
-    env["PYTHONPATH"] = os.pathsep.join(
-        [os.path.abspath(os.path.join(os.path.dirname(__file__),
-                                      os.pardir, os.pardir))] +
-        os.environ.get("PYTHONPATH", "").split(os.pathsep))
+    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                             os.pardir, os.pardir))
+    existing = [p for p in
+                os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env["PYTHONPATH"] = os.pathsep.join([repo_root] + existing)
     if use_jax_coordinator:
+        from horovod_tpu.run.run import free_port
         env["HOROVOD_COORDINATOR_ADDR"] = (
             f"{controller_addr}:{free_port()}")
 
@@ -50,14 +53,26 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
     job = launcher.launch(slots, command, controller_addr, controller_port,
                           rendezvous_port=rendezvous_port, extra_env=env)
     try:
-        job.wait()
+        try:
+            job.wait()
+        except RuntimeError as e:
+            # surface the failed rank's shipped traceback when available
+            for r in range(np):
+                payload = kv.get(f"runfunc/result/{r}")
+                if payload is None:
+                    continue
+                ok, value = pickle.loads(payload)
+                if not ok:
+                    raise RuntimeError(
+                        f"rank {r} raised:\n{value}") from e
+            raise
         results = []
         for r in range(np):
             payload = kv_wait("127.0.0.1", rendezvous_port,
                               f"runfunc/result/{r}", timeout=timeout)
             ok, value = pickle.loads(payload)
             if not ok:
-                raise RuntimeError(f"rank {r} raised: {value}")
+                raise RuntimeError(f"rank {r} raised:\n{value}")
             results.append(value)
         return results
     finally:

@@ -50,13 +50,17 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class KVStoreServer:
-    """Threaded HTTP KV server; ``port=0`` binds an ephemeral port."""
+    """Threaded HTTP KV server; ``port=0`` binds an ephemeral port.
 
-    def __init__(self, port=0):
+    Binds loopback by default — the store carries pickled functions, so it
+    must not be reachable from the network unless the job actually spans
+    hosts (pass ``host="0.0.0.0"`` then)."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
         handler = type("Handler", (_Handler,),
                        {"store": {}, "lock": threading.Lock()})
         self._handler_cls = handler
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread = None
 
     @property

@@ -103,7 +103,8 @@ def _run(args):
         controller_addr = "127.0.0.1"
     controller_port = 0
 
-    kv = KVStoreServer()
+    all_local = all(s.hostname in launcher.LOCAL_HOSTS for s in slots)
+    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0")
     rendezvous_port = kv.start()
 
     extra_env = config_parser.args_to_env(args)

@@ -1,0 +1,109 @@
+"""TensorFlow adapter (reference: ``horovod/tensorflow/__init__.py``).
+
+Eager-mode TF2 over the native core's host data plane, mirroring the torch
+adapter: tensors bridge through numpy into the name-negotiated queue
+(reference role: the ``HorovodAllreduceOp`` custom kernels,
+``tensorflow/mpi_ops.cc:287-460``). TensorFlow is not part of this image's
+baked environment, so the module import-gates: everything works when TF is
+installed, and a clear error points JAX-first users to the native path.
+
+``DistributedGradientTape`` wraps ``tf.GradientTape`` so ``gradient()``
+returns allreduced gradients (reference ``__init__.py:475-531``);
+``broadcast_variables`` syncs initial state (``__init__.py:139``).
+"""
+
+try:
+    import tensorflow as tf
+except ImportError as e:  # pragma: no cover - TF absent in this image
+    raise ImportError(
+        "horovod_tpu.tensorflow requires tensorflow, which is not "
+        "installed. On TPU, prefer the JAX-native API (import horovod_tpu "
+        "as hvd) — it is the compiled, first-class path.") from e
+
+import numpy as np
+
+from horovod_tpu.basics import (cross_rank, cross_size, init,
+                                is_initialized, local_rank, local_size,
+                                rank, shutdown, size)
+from horovod_tpu.torch.mpi_ops import Adasum, Average, Max, Min, Sum
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size",
+    "Sum", "Average", "Adasum", "Min", "Max",
+    "allreduce", "allgather", "broadcast", "broadcast_variables",
+    "DistributedGradientTape",
+]
+
+
+def _ensure_core():
+    from horovod_tpu import _core, basics
+    if not basics.is_initialized():
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call hvd.init()")
+    if not _core.is_initialized():
+        _core.init(rank=0, size=1)
+    return _core
+
+_counters = {}
+
+
+def _auto_name(kind, name):
+    if name is not None:
+        return name
+    n = _counters.get(kind, 0)
+    _counters[kind] = n + 1
+    return f"tf.{kind}.{n}"
+
+
+def allreduce(tensor, average=True, name=None, op=None):
+    core = _ensure_core()
+    op = op or (Average if average else Sum)
+    out = core.allreduce(np.asarray(tensor), _auto_name("allreduce", name),
+                         op=op)
+    return tf.convert_to_tensor(out)
+
+
+def allgather(tensor, name=None):
+    core = _ensure_core()
+    out = core.allgather(np.asarray(tensor), _auto_name("allgather", name))
+    return tf.convert_to_tensor(out)
+
+
+def broadcast(tensor, root_rank=0, name=None):
+    core = _ensure_core()
+    out = core.broadcast(np.asarray(tensor), _auto_name("broadcast", name),
+                         root_rank=root_rank)
+    return tf.convert_to_tensor(out)
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assign every variable rank ``root_rank``'s value (reference
+    ``broadcast_variables``, ``tensorflow/__init__.py:139``)."""
+    for i, v in enumerate(variables):
+        v.assign(broadcast(v.value(), root_rank, name=f"bv.{i}"))
+
+
+class DistributedGradientTape:
+    """``tf.GradientTape`` wrapper whose ``gradient()`` allreduces
+    (reference ``tensorflow/__init__.py:475-531``)."""
+
+    def __init__(self, tape, op=Average):
+        self._tape = tape
+        self._op = op
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def watch(self, t):
+        self._tape.watch(t)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return [None if g is None else
+                allreduce(g, op=self._op, name=f"tape.{i}")
+                for i, g in enumerate(grads)]

@@ -1,0 +1,43 @@
+"""Examples as smoke tests (reference CI pattern: examples run under
+mpirun/horovodrun in the Buildkite pipeline, gen-pipeline.sh:127-168)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _run(cmd, extra_env=None, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    rv = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=timeout, cwd=REPO)
+    assert rv.returncode == 0, rv.stdout + "\n" + rv.stderr
+    return rv.stdout
+
+
+def test_jax_mnist_example():
+    out = _run([sys.executable, "examples/jax_mnist.py"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8",
+                          "PALLAS_AXON_POOL_IPS": ""})
+    assert "done" in out
+
+
+def test_pytorch_mnist_example_under_hvdrun():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, "examples/pytorch_mnist.py"])
+
+
+def test_synthetic_benchmark_tiny():
+    out = _run([sys.executable, "examples/jax_synthetic_benchmark.py",
+                "--model", "resnet18", "--batch-size", "2",
+                "--image-size", "32", "--num-warmup-batches", "1",
+                "--num-batches-per-iter", "2", "--num-iters", "2"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8",
+                          "PALLAS_AXON_POOL_IPS": ""})
+    assert "Img/sec per chip" in out
