@@ -1,0 +1,50 @@
+// Gaussian-process regression + expected-improvement acquisition.
+//
+// Role of the reference's horovod/common/optim/{gaussian_process,
+// bayesian_optimization}.cc — re-implemented without Eigen/LBFGS: an RBF
+// kernel with fixed length-scale over normalized [0,1]^d inputs, Cholesky
+// solve, and EI maximized over random candidates. Sufficient for the 2-D
+// (fusion threshold x cycle time) tuning space.
+#ifndef HVD_GAUSSIAN_PROCESS_H
+#define HVD_GAUSSIAN_PROCESS_H
+
+#include <cstdint>
+#include <vector>
+
+namespace hvd {
+
+class GaussianProcess {
+ public:
+  explicit GaussianProcess(double length_scale = 0.3,
+                           double noise = 1e-4)
+      : length_scale_(length_scale), noise_(noise) {}
+
+  // Fit on observations (x in [0,1]^d, y arbitrary scale; y is z-score
+  // normalized internally).
+  void Fit(const std::vector<std::vector<double>>& xs,
+           const std::vector<double>& ys);
+  // Posterior mean and variance (of the normalized target) at x.
+  void Predict(const std::vector<double>& x, double& mean,
+               double& var) const;
+  // Expected improvement over the best observed y (maximization).
+  double ExpectedImprovement(const std::vector<double>& x,
+                             double xi = 0.01) const;
+  bool fitted() const { return !xs_.empty(); }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  double length_scale_;
+  double noise_;
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_norm_;
+  double y_mean_ = 0, y_std_ = 1;
+  double best_norm_ = 0;
+  std::vector<std::vector<double>> chol_;  // lower-triangular L
+  std::vector<double> alpha_;              // K^-1 y
+};
+
+}  // namespace hvd
+
+#endif  // HVD_GAUSSIAN_PROCESS_H

@@ -129,6 +129,11 @@ struct RequestList {
 struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
+  // autotune piggyback (reference: Controller::SynchronizeParameters) —
+  // when set, workers adopt these tuned values for the next cycles
+  bool has_tuned_params = false;
+  int64_t tuned_fusion_threshold = 0;
+  int64_t tuned_cycle_time_us = 0;
 
   std::vector<uint8_t> Serialize() const;
   static ResponseList Deserialize(const std::vector<uint8_t>& buf);

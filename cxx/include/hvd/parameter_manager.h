@@ -1,0 +1,76 @@
+// Autotuner: online Bayesian optimization of the fusion threshold and
+// cycle time.
+//
+// Role of the reference's horovod/common/parameter_manager.{h,cc}: score
+// each sample window as bytes/sec of allreduced payload, discard warmup
+// windows, propose the next (fusion_threshold, cycle_time) via GP expected
+// improvement, and converge on the best after a sample budget. The
+// coordinator runs it; tuned values ride to workers in the ResponseList
+// (reference: Controller::SynchronizeParameters).
+#ifndef HVD_PARAMETER_MANAGER_H
+#define HVD_PARAMETER_MANAGER_H
+
+#include <cstdint>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "hvd/gaussian_process.h"
+
+namespace hvd {
+
+class ParameterManager {
+ public:
+  struct Options {
+    bool enabled = false;
+    int warmup_samples = 3;
+    int cycles_per_sample = 50;
+    int max_samples = 20;
+    double gp_noise = 1e-3;
+    std::string log_file;
+    uint64_t seed = 12345;
+  };
+
+  void Initialize(const Options& opts, int64_t fusion_threshold,
+                  double cycle_time_ms);
+  bool active() const { return opts_.enabled && !done_; }
+
+  // Record one background cycle's processed payload. Returns true when the
+  // tuned parameters changed (caller re-broadcasts them).
+  bool Update(int64_t bytes, double elapsed_sec);
+
+  int64_t fusion_threshold() const { return current_fusion_; }
+  double cycle_time_ms() const { return current_cycle_ms_; }
+  int64_t best_fusion_threshold() const { return best_fusion_; }
+  double best_cycle_time_ms() const { return best_cycle_ms_; }
+  double best_score() const { return best_score_; }
+  int samples() const { return static_cast<int>(ys_.size()); }
+
+ private:
+  void Propose();
+  double NextRand();
+
+  Options opts_;
+  bool done_ = false;
+  int cycles_ = 0;
+  int64_t bytes_acc_ = 0;
+  double time_acc_ = 0;
+  int warmup_left_ = 0;
+
+  // normalized [0,1]^2 coords: x0 = log2(fusion)/26, x1 = cycle/25
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_;
+  GaussianProcess gp_;
+
+  int64_t current_fusion_ = 64 << 20;
+  double current_cycle_ms_ = 1.0;
+  int64_t best_fusion_ = 64 << 20;
+  double best_cycle_ms_ = 1.0;
+  double best_score_ = -1;
+  uint64_t rng_state_ = 12345;
+  std::ofstream log_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_PARAMETER_MANAGER_H

@@ -1,0 +1,101 @@
+#include "hvd/gaussian_process.h"
+
+#include <cmath>
+
+namespace hvd {
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-d2 / (2.0 * length_scale_ * length_scale_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& xs,
+                          const std::vector<double>& ys) {
+  size_t n = xs.size();
+  xs_ = xs;
+  // z-score normalize targets
+  y_mean_ = 0;
+  for (double y : ys) y_mean_ += y;
+  y_mean_ /= n;
+  y_std_ = 0;
+  for (double y : ys) y_std_ += (y - y_mean_) * (y - y_mean_);
+  y_std_ = std::sqrt(y_std_ / n);
+  if (y_std_ < 1e-12) y_std_ = 1.0;
+  ys_norm_.resize(n);
+  best_norm_ = -1e300;
+  for (size_t i = 0; i < n; ++i) {
+    ys_norm_[i] = (ys[i] - y_mean_) / y_std_;
+    if (ys_norm_[i] > best_norm_) best_norm_ = ys_norm_[i];
+  }
+
+  // K + noise*I, Cholesky L L^T = K
+  std::vector<std::vector<double>> K(n, std::vector<double>(n));
+  for (size_t i = 0; i < n; ++i)
+    for (size_t j = 0; j < n; ++j) {
+      K[i][j] = Kernel(xs_[i], xs_[j]);
+      if (i == j) K[i][j] += noise_;
+    }
+  chol_.assign(n, std::vector<double>(n, 0.0));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double sum = K[i][j];
+      for (size_t k = 0; k < j; ++k) sum -= chol_[i][k] * chol_[j][k];
+      if (i == j) {
+        chol_[i][i] = std::sqrt(sum > 1e-12 ? sum : 1e-12);
+      } else {
+        chol_[i][j] = sum / chol_[j][j];
+      }
+    }
+  }
+  // alpha = K^-1 y via two triangular solves
+  std::vector<double> z(n);
+  for (size_t i = 0; i < n; ++i) {
+    double sum = ys_norm_[i];
+    for (size_t k = 0; k < i; ++k) sum -= chol_[i][k] * z[k];
+    z[i] = sum / chol_[i][i];
+  }
+  alpha_.assign(n, 0.0);
+  for (size_t ii = n; ii-- > 0;) {
+    double sum = z[ii];
+    for (size_t k = ii + 1; k < n; ++k) sum -= chol_[k][ii] * alpha_[k];
+    alpha_[ii] = sum / chol_[ii][ii];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double& mean,
+                              double& var) const {
+  size_t n = xs_.size();
+  std::vector<double> kstar(n);
+  for (size_t i = 0; i < n; ++i) kstar[i] = Kernel(x, xs_[i]);
+  mean = 0;
+  for (size_t i = 0; i < n; ++i) mean += kstar[i] * alpha_[i];
+  // v = L^-1 k*, var = k(x,x) - v^T v
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; ++i) {
+    double sum = kstar[i];
+    for (size_t k = 0; k < i; ++k) sum -= chol_[i][k] * v[k];
+    v[i] = sum / chol_[i][i];
+  }
+  var = Kernel(x, x);
+  for (size_t i = 0; i < n; ++i) var -= v[i] * v[i];
+  if (var < 1e-12) var = 1e-12;
+}
+
+double GaussianProcess::ExpectedImprovement(const std::vector<double>& x,
+                                            double xi) const {
+  double mean, var;
+  Predict(x, mean, var);
+  double sigma = std::sqrt(var);
+  double imp = mean - best_norm_ - xi;
+  double z = imp / sigma;
+  double cdf = 0.5 * std::erfc(-z / std::sqrt(2.0));
+  double pdf = std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+  return imp * cdf + sigma * pdf;
+}
+
+}  // namespace hvd

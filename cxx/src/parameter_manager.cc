@@ -1,0 +1,118 @@
+#include "hvd/parameter_manager.h"
+
+#include <cmath>
+
+namespace hvd {
+
+namespace {
+
+// param space bounds (reference tunes fusion 0..64MB, cycle 1..25ms)
+constexpr double kMaxLogFusion = 26.0;  // 2^26 = 64 MB
+constexpr double kMinLogFusion = 16.0;  // 64 KB
+constexpr double kMaxCycleMs = 25.0;
+constexpr double kMinCycleMs = 0.5;
+
+std::vector<double> Encode(int64_t fusion, double cycle_ms) {
+  double lf = std::log2(static_cast<double>(fusion < 1 ? 1 : fusion));
+  return {(lf - kMinLogFusion) / (kMaxLogFusion - kMinLogFusion),
+          (cycle_ms - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs)};
+}
+
+void Decode(const std::vector<double>& x, int64_t& fusion,
+            double& cycle_ms) {
+  double lf = kMinLogFusion + x[0] * (kMaxLogFusion - kMinLogFusion);
+  fusion = static_cast<int64_t>(std::pow(2.0, lf));
+  cycle_ms = kMinCycleMs + x[1] * (kMaxCycleMs - kMinCycleMs);
+}
+
+}  // namespace
+
+void ParameterManager::Initialize(const Options& opts,
+                                  int64_t fusion_threshold,
+                                  double cycle_time_ms) {
+  opts_ = opts;
+  gp_ = GaussianProcess(0.3, opts.gp_noise);
+  current_fusion_ = best_fusion_ = fusion_threshold;
+  current_cycle_ms_ = best_cycle_ms_ = cycle_time_ms;
+  warmup_left_ = opts.warmup_samples;
+  rng_state_ = opts.seed;
+  if (!opts.log_file.empty() && opts.enabled) {
+    log_.open(opts.log_file, std::ios::out | std::ios::trunc);
+    log_ << "sample,fusion_threshold,cycle_time_ms,score_bytes_per_sec\n";
+  }
+}
+
+double ParameterManager::NextRand() {
+  // xorshift64
+  rng_state_ ^= rng_state_ << 13;
+  rng_state_ ^= rng_state_ >> 7;
+  rng_state_ ^= rng_state_ << 17;
+  return static_cast<double>(rng_state_ % 1000000) / 1000000.0;
+}
+
+bool ParameterManager::Update(int64_t bytes, double elapsed_sec) {
+  if (!active()) return false;
+  bytes_acc_ += bytes;
+  time_acc_ += elapsed_sec;
+  if (++cycles_ < opts_.cycles_per_sample) return false;
+
+  double score = time_acc_ > 0
+                     ? static_cast<double>(bytes_acc_) / time_acc_ : 0;
+  cycles_ = 0;
+  bytes_acc_ = 0;
+  time_acc_ = 0;
+
+  if (warmup_left_ > 0) {  // discard warmup windows (reference warmup)
+    --warmup_left_;
+    return false;
+  }
+
+  xs_.push_back(Encode(current_fusion_, current_cycle_ms_));
+  ys_.push_back(score);
+  if (log_.is_open()) {
+    log_ << ys_.size() << "," << current_fusion_ << ","
+         << current_cycle_ms_ << "," << score << "\n";
+    log_.flush();
+  }
+  if (score > best_score_) {
+    best_score_ = score;
+    best_fusion_ = current_fusion_;
+    best_cycle_ms_ = current_cycle_ms_;
+  }
+  if (static_cast<int>(ys_.size()) >= opts_.max_samples) {
+    current_fusion_ = best_fusion_;
+    current_cycle_ms_ = best_cycle_ms_;
+    done_ = true;
+    if (log_.is_open()) {
+      log_ << "converged," << best_fusion_ << "," << best_cycle_ms_ << ","
+           << best_score_ << "\n";
+      log_.flush();
+    }
+    return true;
+  }
+  Propose();
+  return true;
+}
+
+void ParameterManager::Propose() {
+  // first few samples explore randomly, then EI over the GP posterior
+  if (ys_.size() < 3) {
+    std::vector<double> x = {NextRand(), NextRand()};
+    Decode(x, current_fusion_, current_cycle_ms_);
+    return;
+  }
+  gp_.Fit(xs_, ys_);
+  double best_ei = -1;
+  std::vector<double> best_x = xs_.back();
+  for (int c = 0; c < 64; ++c) {
+    std::vector<double> x = {NextRand(), NextRand()};
+    double ei = gp_.ExpectedImprovement(x);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_x = x;
+    }
+  }
+  Decode(best_x, current_fusion_, current_cycle_ms_);
+}
+
+}  // namespace hvd
