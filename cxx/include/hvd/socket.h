@@ -33,6 +33,9 @@ class TcpConnection {
     return SendFrame(buf.data(), static_cast<uint32_t>(buf.size()));
   }
   Status RecvFrame(std::vector<uint8_t>& out);
+  // Frame receive with a whole-frame absolute deadline (for handshakes
+  // where a silent or dripping peer must not block the caller).
+  Status RecvFrameDeadline(std::vector<uint8_t>& out, double timeout_sec);
   // Raw (unframed) IO for bulk tensor payloads.
   Status SendRaw(const void* data, size_t len);
   Status RecvRaw(void* data, size_t len);

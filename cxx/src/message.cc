@@ -1,6 +1,23 @@
 #include "hvd/message.h"
 
+#include <cstring>
+
 namespace hvd {
+
+// Scale factors ride the wire bit-exactly: every rank (and the response
+// cache's parameter comparison) must see the identical double, so the
+// codec must not round-trip through any lossy representation.
+static int64_t DoubleBits(double d) {
+  int64_t b;
+  std::memcpy(&b, &d, sizeof(b));
+  return b;
+}
+
+static double BitsToDouble(int64_t b) {
+  double d;
+  std::memcpy(&d, &b, sizeof(d));
+  return d;
+}
 
 const char* RequestTypeName(Request::Type t) {
   switch (t) {
@@ -24,8 +41,8 @@ void Request::Serialize(Writer& w) const {
   w.i32(root_rank);
   w.i32(shape.ndim());
   for (int i = 0; i < shape.ndim(); ++i) w.i64(shape.dim(i));
-  w.i64(static_cast<int64_t>(prescale_factor * 1e9));
-  w.i64(static_cast<int64_t>(postscale_factor * 1e9));
+  w.i64(DoubleBits(prescale_factor));
+  w.i64(DoubleBits(postscale_factor));
   w.u8(reduce_op);
 }
 
@@ -38,8 +55,8 @@ Request Request::Deserialize(Reader& r) {
   q.root_rank = r.i32();
   int ndim = r.i32();
   for (int i = 0; i < ndim; ++i) q.shape.AddDim(r.i64());
-  q.prescale_factor = static_cast<double>(r.i64()) / 1e9;
-  q.postscale_factor = static_cast<double>(r.i64()) / 1e9;
+  q.prescale_factor = BitsToDouble(r.i64());
+  q.postscale_factor = BitsToDouble(r.i64());
   q.reduce_op = r.u8();
   return q;
 }

@@ -80,13 +80,20 @@ void PeerMesh::AcceptLoop() {
     }
     auto conn = server_->Accept(0.2);
     if (!conn) continue;
+    // Deadline on the hello frame: a connected-but-silent (or dripping)
+    // peer must not block mesh bring-up for everyone else.
     std::vector<uint8_t> hello;
-    if (!conn->RecvFrame(hello).ok()) continue;
+    if (!conn->RecvFrameDeadline(hello, 5.0).ok() || hello.size() < 4)
+      continue;
     Reader r(hello);
     int peer = r.i32();
     conn->SetNonBlocking();
     {
       std::lock_guard<std::mutex> lock(mu_);
+      // Reject out-of-range ranks and hellos for ranks already
+      // connected — an arbitrary claimed rank must not hijack an
+      // existing peer's connection entry.
+      if (peer < 0 || peer >= size_ || conns_.count(peer)) continue;
       conns_[peer] = std::move(conn);
     }
     cv_.notify_all();

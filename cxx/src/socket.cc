@@ -115,6 +115,49 @@ Status TcpConnection::SendRaw(const void* data, size_t len) {
   return Status::OK();
 }
 
+Status TcpConnection::RecvFrameDeadline(std::vector<uint8_t>& out,
+                                        double timeout_sec) {
+  // Whole-frame absolute deadline (header + payload): a peer dripping
+  // bytes cannot keep resetting a per-recv timer. Temporarily
+  // non-blocking; original flags restored on every exit path.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_sec);
+  int flags = fcntl(fd_, F_GETFL, 0);
+  fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
+  auto recv_all = [&](void* data, size_t len) -> Status {
+    uint8_t* p = static_cast<uint8_t*>(data);
+    size_t got = 0;
+    while (got < len) {
+      ssize_t n = ::recv(fd_, p + got, len - got, 0);
+      if (n > 0) {
+        got += static_cast<size_t>(n);
+        continue;
+      }
+      if (n == 0) return Status::Aborted("connection closed by peer");
+      if (errno == EINTR) continue;
+      if (errno != EAGAIN && errno != EWOULDBLOCK)
+        return Status::Unknown(std::string("recv failed: ") +
+                               std::strerror(errno));
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now());
+      if (left.count() <= 0) return Status::Unknown("recv deadline exceeded");
+      struct pollfd pfd;
+      pfd.fd = fd_;
+      pfd.events = POLLIN;
+      ::poll(&pfd, 1, static_cast<int>(left.count()));
+    }
+    return Status::OK();
+  };
+  uint32_t len = 0;
+  Status s = recv_all(&len, 4);
+  if (s.ok()) {
+    out.resize(len);
+    if (len > 0) s = recv_all(out.data(), len);
+  }
+  fcntl(fd_, F_SETFL, flags);
+  return s;
+}
+
 Status TcpConnection::RecvRaw(void* data, size_t len) {
   uint8_t* p = static_cast<uint8_t*>(data);
   size_t got = 0;

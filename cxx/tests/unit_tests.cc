@@ -53,6 +53,18 @@ static void TestMessageRoundtrip() {
   CHECK(std::abs(back.requests[0].prescale_factor - 0.5) < 1e-12);
   CHECK(back.requests[0].reduce_op == 1);
 
+  // scale factors must round-trip BIT-exactly (0.1 is not representable;
+  // a lossy codec would defeat response-cache parameter comparison)
+  Request q2 = MakeReq("grad/w2", 0);
+  q2.prescale_factor = 0.1;
+  q2.postscale_factor = 3.0e300;
+  RequestList rl2;
+  rl2.requests.push_back(q2);
+  auto bytes2 = rl2.Serialize();
+  RequestList back2 = RequestList::Deserialize(bytes2);
+  CHECK(back2.requests[0].prescale_factor == 0.1);
+  CHECK(back2.requests[0].postscale_factor == 3.0e300);
+
   ResponseList pl;
   Response p;
   p.type = Response::ALLGATHER;

@@ -21,14 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
 from horovod_tpu.parallel import mesh as mesh_lib
-
-# Reduction ops (reference: horovod/torch/mpi_ops.py Sum/Average/Adasum).
-Sum = "sum"
-Average = "average"
-Adasum = "adasum"
-Min = "min"
-Max = "max"
 
 
 def _resolve_axes(axes):

@@ -25,7 +25,7 @@ import numpy as np
 from horovod_tpu.basics import (cross_rank, cross_size, init,
                                 is_initialized, local_rank, local_size,
                                 rank, shutdown, size)
-from horovod_tpu.torch.mpi_ops import Adasum, Average, Max, Min, Sum
+from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",

@@ -36,7 +36,14 @@ __all__ = [
 class _DistributedOptimizer(torch.optim.Optimizer):
     """Wraps a torch optimizer: gradient-ready hooks fire async allreduces,
     ``step()`` synchronizes them all, then runs the inner step (reference
-    ``horovod/torch/__init__.py:57-212``)."""
+    ``horovod/torch/__init__.py:57-212``).
+
+    ``backward_passes_per_step=N`` follows the reference contract: grads
+    accumulate locally over N backwards and the allreduce averages the
+    accumulated SUM across ranks — no division by N (scale the learning
+    rate if you want a micro-batch mean). Note the JAX adapter's
+    ``optax.MultiSteps`` path averages over micro-steps instead.
+    """
 
     def __init__(self, optimizer, named_parameters=None, compression=None,
                  backward_passes_per_step=1, op=Average):
@@ -45,8 +52,6 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._passes = backward_passes_per_step
         self._op = op
         self._handles = {}
-        self._grad_accum = {}
-        self._pass_count = 0
         self._hook_registered = []
 
         if named_parameters is not None:
@@ -62,6 +67,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             raise ValueError(f"duplicate parameter names: {sorted(dups)}")
         self._named = named
         self._name_of = {p: n for n, p in named}
+        self._requires_update = {p for _, p in named if p.requires_grad}
+        # per-param countdown: the hook fires the allreduce on the Nth
+        # backward (reference torch/__init__.py:118-135 _allreduce_delay)
+        self._delay = {p: self._passes for p in self._requires_update}
         self._register_hooks()
 
     # -- torch.optim.Optimizer surface delegates to the inner optimizer --
@@ -80,6 +89,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return self._inner.load_state_dict(sd)
 
     def zero_grad(self, set_to_none=True):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step() or optimizer.synchronize(); this "
+                "would discard gradients with allreduces still in flight")
         return self._inner.zero_grad(set_to_none=set_to_none)
 
     def _register_hooks(self):
@@ -89,31 +103,47 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._hook_registered.append(
                 p.register_post_accumulate_grad_hook(self._make_hook(name)))
 
+    def _fire_allreduce(self, p):
+        wire, ctx = self._compression.compress(p.grad)
+        from horovod_tpu.torch import mpi_ops
+        h = mpi_ops.allreduce_async(wire, name=self._name_of[p], op=self._op)
+        return h, ctx
+
     def _make_hook(self, name):
         def hook(p):
-            self._grad_accum.setdefault(name, 0)
-            self._grad_accum[name] += 1
-            if self._grad_accum[name] < self._passes:
-                return
-            self._grad_accum[name] = 0
-            if self._passes > 1:
-                p.grad.div_(self._passes)
-            wire, ctx = self._compression.compress(p.grad)
-            from horovod_tpu.torch import mpi_ops
-            h = mpi_ops.allreduce_async(wire, name=name, op=self._op)
-            self._handles[p] = (h, ctx)
+            if p in self._handles and self._handles[p][0] is not None:
+                raise AssertionError(
+                    f"gradient for {name!r} was computed more than "
+                    f"backward_passes_per_step={self._passes} times before "
+                    "step()/synchronize(); call synchronize() between "
+                    "extra backward passes")
+            self._delay[p] -= 1
+            handle, ctx = None, None
+            if self._delay[p] == 0:
+                handle, ctx = self._fire_allreduce(p)
+            self._handles[p] = (handle, ctx)
         return hook
 
     def synchronize(self):
+        # params whose countdown has not elapsed, or whose hook never
+        # fired this step, are allreduced now so step() never consumes
+        # unreduced gradients (reference torch/__init__.py:155-173)
         for p, (h, ctx) in list(self._handles.items()):
+            if h is None:
+                self._handles[p] = self._fire_allreduce(p)
+        for p in self._requires_update - set(self._handles):
+            if p.grad is not None:
+                self._handles[p] = self._fire_allreduce(p)
+        for p, (h, ctx) in self._handles.items():
             out = h.synchronize()
+            self._delay[p] = self._passes
             p.grad.copy_(self._compression.decompress(out, ctx))
         self._handles.clear()
 
     def step(self, closure=None):
-        self._pass_count += 1
-        if self._pass_count % self._passes != 0:
-            return None  # accumulation-only micro step
+        # Always synchronize and run the inner step, like the reference:
+        # gradient accumulation is expressed by the per-param delay
+        # counters, not by skipping optimizer steps.
         self.synchronize()
         return self._inner.step(closure)
 
@@ -152,7 +182,13 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
     if isinstance(optimizer, _DistributedOptimizer):
         optimizer = optimizer._inner
     sd = optimizer.state_dict()
-    # scalars (step counters, hyperparams): pickled from root
+    # Root drives the whole broadcast set: non-root ranks may have EMPTY
+    # state (fresh process restoring from a rank-0 checkpoint), so the
+    # list of (pid, key, shape, dtype) comes from root and missing
+    # tensors are materialized locally before the tensor broadcasts —
+    # otherwise ranks would enqueue mismatched sets and negotiation
+    # would stall (reference torch/__init__.py:472-560 initializes
+    # state on all ranks before broadcasting).
     meta = {
         "param_groups": sd["param_groups"],
         "scalars": {
@@ -160,12 +196,36 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
             for pid, st in sd["state"].items() for k, v in st.items()
             if not torch.is_tensor(v)
         },
+        "tensors": [
+            (pid, k, list(v.shape), str(v.dtype))
+            for pid, st in sd["state"].items() for k, v in st.items()
+            if torch.is_tensor(v)
+        ],
     }
     meta = broadcast_object(meta, root_rank, name="bos.meta")
     sd["param_groups"] = meta["param_groups"]
+    # Root's state set is authoritative: local entries root does not have
+    # (e.g. this rank warmed momentum root never had) must not survive,
+    # or ranks would step with divergent state after the "sync".
+    root_keys = ({(pid, k) for (pid, k) in meta["scalars"]} |
+                 {(pid, k) for pid, k, _, _ in meta["tensors"]})
+    for pid, st in list(sd["state"].items()):
+        for k in list(st):
+            if (pid, k) not in root_keys:
+                del st[k]
+        if not st:
+            del sd["state"][pid]
     for (pid, k), v in meta["scalars"].items():
         sd["state"].setdefault(pid, {})[k] = v
-    tensors = [(f"bos.{pid}.{k}", v) for pid, st in sd["state"].items()
-               for k, v in st.items() if torch.is_tensor(v)]
+    tensors = []
+    for pid, k, shape, dtype_s in meta["tensors"]:
+        st = sd["state"].setdefault(pid, {})
+        t = st.get(k)
+        dtype = getattr(torch, dtype_s.replace("torch.", ""))
+        if (not torch.is_tensor(t) or list(t.shape) != shape
+                or t.dtype != dtype):
+            t = torch.zeros(shape, dtype=dtype)
+            st[k] = t
+        tensors.append((f"bos.{pid}.{k}", t))
     broadcast_parameters(tensors, root_rank)
     optimizer.load_state_dict(sd)

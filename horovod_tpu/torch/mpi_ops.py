@@ -16,12 +16,7 @@ import numpy as np
 import torch
 
 from horovod_tpu import _core
-
-Sum = "sum"
-Average = "average"
-Adasum = "adasum"
-Min = "min"
-Max = "max"
+from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
 
 _name_counter = {}
 

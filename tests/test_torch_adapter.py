@@ -55,6 +55,56 @@ def test_single_process_optimizer_matches_plain(hvd_torch):
         assert torch.allclose(p, q, atol=1e-6)
 
 
+def test_backward_passes_per_step_accumulates(hvd_torch):
+    """Documented Horovod usage: N backwards then one step() must apply
+    the accumulated (allreduced) gradient — step() never silently no-ops
+    (reference torch/__init__.py:57-212)."""
+    torch.manual_seed(0)
+    model = torch.nn.Linear(4, 1, bias=False)
+    ref = torch.nn.Linear(4, 1, bias=False)
+    ref.load_state_dict(model.state_dict())
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2)
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+    xs = [torch.randn(8, 4) for _ in range(2)]
+    for x in xs:
+        model(x).sum().backward()
+    opt.step()  # must synchronize + step, not skip
+    for x in xs:
+        ref(x).sum().backward()
+    ref_opt.step()
+    assert torch.allclose(model.weight, ref.weight, atol=1e-6)
+
+
+def test_step_syncs_even_with_pending_delay(hvd_torch):
+    """step() after a single backward with backward_passes_per_step=2
+    still allreduces the pending gradient and steps (reference
+    synchronize() missing-handle fallback)."""
+    model = torch.nn.Linear(4, 1, bias=False)
+    before = model.weight.detach().clone()
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2)
+    model(torch.ones(2, 4)).sum().backward()
+    opt.step()
+    assert not torch.allclose(model.weight, before)
+
+
+def test_zero_grad_with_outstanding_handles_raises(hvd_torch):
+    model = torch.nn.Linear(4, 1, bias=False)
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    model(torch.ones(2, 4)).sum().backward()  # hook fired, handle pending
+    with pytest.raises(AssertionError, match="zero_grad"):
+        opt.zero_grad()
+    opt.synchronize()
+    opt.zero_grad()  # fine after synchronize
+
+
 def test_duplicate_parameter_names_rejected(hvd_torch):
     model = torch.nn.Linear(2, 2)
     with pytest.raises(ValueError, match="duplicate parameter names"):
@@ -157,15 +207,46 @@ def test_torch_fp16_compression_and_backward_passes():
             compression=hvd.Compression.fp16,
             backward_passes_per_step=2)
         x = torch.ones(4, 4) * (hvd.rank() + 1)
-        for _ in range(4):  # 2 real steps
-            loss = model(x).sum()
-            loss.backward()
+        for _ in range(2):  # 2 real steps of 2 accumulated backwards
+            for _ in range(2):
+                model(x).sum().backward()  # 2nd backward fires fp16 hook
             opt.step()
             opt.zero_grad()
         return model.weight.detach().numpy().ravel().tolist()
 
     results = api.run(train, np=2, extra_env={"JAX_PLATFORMS": "cpu"})
     np.testing.assert_allclose(results[0], results[1], atol=1e-3)
+
+
+def test_broadcast_optimizer_state_fresh_nonroot():
+    """Canonical restore scenario: root has momentum state (stepped),
+    non-root is fresh with EMPTY state. Root drives the broadcast set;
+    non-root materializes missing tensors instead of stalling."""
+    def fn():
+        import torch
+
+        import horovod_tpu.torch as hvd
+        hvd.init()
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 1, bias=False)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        if hvd.rank() == 0:
+            # root "restored from checkpoint": momentum buffers exist
+            model(torch.ones(2, 4)).sum().backward()
+            opt.step()
+            opt.zero_grad()
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        sd = opt.state_dict()
+        bufs = [v for st in sd["state"].values()
+                for k, v in st.items() if torch.is_tensor(v)]
+        return [b.numpy().ravel().tolist() for b in bufs]
+
+    results = api.run(fn, np=2, extra_env={"JAX_PLATFORMS": "cpu"})
+    assert results[0], "root should have momentum buffers"
+    assert len(results[0]) == len(results[1])
+    for a, b in zip(results[0], results[1]):
+        np.testing.assert_allclose(a, b, atol=1e-6)
 
 
 def test_metric_average_callback_multiprocess():
