@@ -133,7 +133,7 @@ struct ResponseList {
   // when set, workers adopt these tuned values for the next cycles
   bool has_tuned_params = false;
   int64_t tuned_fusion_threshold = 0;
-  int64_t tuned_cycle_time_us = 0;
+  double tuned_cycle_time_ms = 0;  // serialized bit-exactly
 
   std::vector<uint8_t> Serialize() const;
   static ResponseList Deserialize(const std::vector<uint8_t>& buf);

@@ -44,6 +44,13 @@ void hvdc_release(int handle);
 // Convenience: negotiated barrier across all ranks (blocking).
 int hvdc_barrier();
 
+// Autotuner introspection: current (possibly tuned) fusion threshold and
+// cycle time, plus coordinator-side sample count / convergence flag
+// (workers report samples=-1). Returns 1 when HOROVOD_AUTOTUNE is on,
+// 0 when off, -1 when the core is not initialized.
+int hvdc_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms,
+                        int* samples, int* done);
+
 }  // extern "C"
 
 #endif  // HVD_OPERATIONS_H

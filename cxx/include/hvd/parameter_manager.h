@@ -34,6 +34,8 @@ class ParameterManager {
   void Initialize(const Options& opts, int64_t fusion_threshold,
                   double cycle_time_ms);
   bool active() const { return opts_.enabled && !done_; }
+  bool enabled() const { return opts_.enabled; }
+  bool done() const { return done_; }
 
   // Record one background cycle's processed payload. Returns true when the
   // tuned parameters changed (caller re-broadcasts them).

@@ -33,9 +33,11 @@ class TcpConnection {
     return SendFrame(buf.data(), static_cast<uint32_t>(buf.size()));
   }
   Status RecvFrame(std::vector<uint8_t>& out);
-  // Frame receive with a whole-frame absolute deadline (for handshakes
-  // where a silent or dripping peer must not block the caller).
-  Status RecvFrameDeadline(std::vector<uint8_t>& out, double timeout_sec);
+  // Frame receive with a whole-frame absolute deadline and a length cap
+  // (for pre-authentication handshakes: a silent, dripping, or hostile
+  // peer must not block the caller or force a huge allocation).
+  Status RecvFrameDeadline(std::vector<uint8_t>& out, double timeout_sec,
+                           uint32_t max_len = 1 << 16);
   // Raw (unframed) IO for bulk tensor payloads.
   Status SendRaw(const void* data, size_t len);
   Status RecvRaw(void* data, size_t len);

@@ -113,7 +113,7 @@ std::vector<uint8_t> ResponseList::Serialize() const {
   w.u8(shutdown ? 1 : 0);
   w.u8(has_tuned_params ? 1 : 0);
   w.i64(tuned_fusion_threshold);
-  w.i64(tuned_cycle_time_us);
+  w.i64(DoubleBits(tuned_cycle_time_ms));
   w.i32(static_cast<int32_t>(responses.size()));
   for (const auto& p : responses) p.Serialize(w);
   return w.take();
@@ -125,7 +125,7 @@ ResponseList ResponseList::Deserialize(const std::vector<uint8_t>& buf) {
   l.shutdown = r.u8() != 0;
   l.has_tuned_params = r.u8() != 0;
   l.tuned_fusion_threshold = r.i64();
-  l.tuned_cycle_time_us = r.i64();
+  l.tuned_cycle_time_ms = BitsToDouble(r.i64());
   int32_t n = r.i32();
   l.responses.reserve(n);
   for (int32_t i = 0; i < n; ++i)

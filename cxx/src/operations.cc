@@ -1,6 +1,7 @@
 #include "hvd/operations.h"
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <memory>
@@ -12,6 +13,7 @@
 #include "hvd/controller.h"
 #include "hvd/cpu_ops.h"
 #include "hvd/negotiator.h"
+#include "hvd/parameter_manager.h"
 #include "hvd/peer_mesh.h"
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
@@ -112,6 +114,14 @@ struct Global {
   std::atomic<bool> initialized{false};
   double cycle_time_ms = 1.0;
   int64_t fusion_threshold = 64 * 1024 * 1024;
+
+  // autotuner (coordinator scores cycles + proposes; tuned params ride
+  // the ResponseList to workers — reference SynchronizeParameters).
+  // tune_mu guards pm + the tuned fusion_threshold/cycle_time_ms pair
+  // against user-thread introspection racing the loop thread.
+  ParameterManager pm;
+  std::mutex tune_mu;
+  std::chrono::steady_clock::time_point last_cycle_tp;
 
   // join state
   std::vector<bool> joined_ranks;     // coordinator
@@ -394,7 +404,35 @@ ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
 
   if (g->stall.Check(g->negotiator.Pending(), g->size)) any_shutdown = true;
   rl.shutdown = any_shutdown;
+
+  // While tuning (and after convergence), every cycle's ResponseList
+  // carries the coordinator's current proposal so all ranks run the
+  // same (fusion threshold, cycle time).
+  if (g->pm.enabled()) {
+    std::lock_guard<std::mutex> lock(g->tune_mu);
+    rl.has_tuned_params = true;
+    rl.tuned_fusion_threshold = g->pm.fusion_threshold();
+    rl.tuned_cycle_time_ms = g->pm.cycle_time_ms();
+    g->fusion_threshold = g->pm.fusion_threshold();
+    g->cycle_time_ms = g->pm.cycle_time_ms();
+  }
   return rl;
+}
+
+// Payload bytes a ResponseList moves through the data plane (the
+// autotuner's score numerator, reference parameter_manager score =
+// bytes/sec over sample windows).
+int64_t ResponsePayloadBytes(const ResponseList& rl) {
+  int64_t bytes = 0;
+  for (const auto& r : rl.responses) {
+    if (r.type != Response::ALLREDUCE && r.type != Response::ADASUM &&
+        r.type != Response::REDUCESCATTER)
+      continue;
+    int64_t elems = 0;
+    for (int64_t c : r.tensor_sizes) elems += c;
+    bytes += elems * static_cast<int64_t>(DataTypeSize(r.dtype));
+  }
+  return bytes;
 }
 
 bool RunLoopOnce() {
@@ -432,6 +470,11 @@ bool RunLoopOnce() {
     if (!s.ok()) return false;
     s = g->control->RecvFinalTensors(rl);
     if (!s.ok()) return false;
+    if (rl.has_tuned_params) {  // adopt the coordinator's tuned values
+      std::lock_guard<std::mutex> lock(g->tune_mu);
+      g->fusion_threshold = rl.tuned_fusion_threshold;
+      g->cycle_time_ms = rl.tuned_cycle_time_ms;
+    }
   }
 
   for (const auto& resp : rl.responses) {
@@ -441,6 +484,22 @@ bool RunLoopOnce() {
     g->timeline.End(resp.tensor_names[0]);
   }
   g->timeline.MarkCycle();
+
+  // Coordinator scores the cycle (bytes moved / wall time incl. the
+  // previous sleep) and advances the Bayesian-opt proposal loop. Idle
+  // cycles are not scored — a pause between bursts of work must not
+  // poison the throughput estimate.
+  if (g->pm.active()) {
+    auto now = std::chrono::steady_clock::now();
+    double elapsed =
+        std::chrono::duration<double>(now - g->last_cycle_tp).count();
+    g->last_cycle_tp = now;
+    int64_t bytes = ResponsePayloadBytes(rl);
+    if (bytes > 0) {
+      std::lock_guard<std::mutex> lock(g->tune_mu);
+      g->pm.Update(bytes, elapsed);
+    }
+  }
   return !rl.shutdown;
 }
 
@@ -515,6 +574,24 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
   // coordinator-only, like the reference (operations.cc:388-395)
   std::string tl = EnvStr("HOROVOD_TIMELINE", "");
   if (!tl.empty() && rank == 0) ng->timeline.Initialize(tl, rank);
+
+  // autotuner runs on the coordinator; workers adopt tuned params from
+  // the ResponseList (reference operations.cc:432-484 + controller.cc:33)
+  {
+    ParameterManager::Options po;
+    po.enabled = EnvBool("HOROVOD_AUTOTUNE", false) && rank == 0;
+    po.log_file = EnvStr("HOROVOD_AUTOTUNE_LOG", "");
+    po.warmup_samples =
+        static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3));
+    po.cycles_per_sample =
+        static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10));
+    po.max_samples = static_cast<int>(
+        EnvInt("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20));
+    po.gp_noise =
+        EnvDouble("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8);
+    ng->pm.Initialize(po, ng->fusion_threshold, ng->cycle_time_ms);
+    ng->last_cycle_tp = std::chrono::steady_clock::now();
+  }
 
   g = ng;
   g->initialized.store(true);
@@ -614,6 +691,20 @@ int hvdc_copy_output(int handle, void* dst) {
 
 void hvdc_release(int handle) {
   if (g) g->handles.Release(handle);
+}
+
+int hvdc_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms,
+                        int* samples, int* done) {
+  if (g == nullptr || !g->initialized.load()) return -1;
+  std::lock_guard<std::mutex> lock(g->tune_mu);
+  if (fusion_threshold) *fusion_threshold = g->fusion_threshold;
+  if (cycle_time_ms) *cycle_time_ms = g->cycle_time_ms;
+  // sample/convergence progress is coordinator-side knowledge; workers
+  // report -1 samples and infer convergence from the adopted values
+  bool coord = g->pm.enabled();
+  if (samples) *samples = coord ? g->pm.samples() : -1;
+  if (done) *done = coord ? (g->pm.done() ? 1 : 0) : 0;
+  return EnvBool("HOROVOD_AUTOTUNE", false) ? 1 : 0;
 }
 
 int hvdc_barrier() {

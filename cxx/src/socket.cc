@@ -116,7 +116,8 @@ Status TcpConnection::SendRaw(const void* data, size_t len) {
 }
 
 Status TcpConnection::RecvFrameDeadline(std::vector<uint8_t>& out,
-                                        double timeout_sec) {
+                                        double timeout_sec,
+                                        uint32_t max_len) {
   // Whole-frame absolute deadline (header + payload): a peer dripping
   // bytes cannot keep resetting a per-recv timer. Temporarily
   // non-blocking; original flags restored on every exit path.
@@ -150,6 +151,9 @@ Status TcpConnection::RecvFrameDeadline(std::vector<uint8_t>& out,
   };
   uint32_t len = 0;
   Status s = recv_all(&len, 4);
+  if (s.ok() && len > max_len)
+    s = Status::InvalidArgument("frame length " + std::to_string(len) +
+                                " exceeds handshake cap");
   if (s.ok()) {
     out.resize(len);
     if (len > 0) s = recv_all(out.data(), len);

@@ -7,8 +7,10 @@
 #include <cstring>
 
 #include "hvd/cpu_ops.h"
+#include "hvd/gaussian_process.h"
 #include "hvd/message.h"
 #include "hvd/negotiator.h"
+#include "hvd/parameter_manager.h"
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
 
@@ -210,8 +212,62 @@ static void TestReductionKernels() {
   CHECK(ha[0] == 0x4200);  // 3.0
 }
 
+static void TestGaussianProcessEI() {
+  // GP posterior should interpolate observations and EI should prefer
+  // unexplored regions near the optimum over well-sampled poor ones.
+  GaussianProcess gp(0.3, 1e-6);
+  std::vector<std::vector<double>> xs = {{0.1}, {0.5}, {0.9}};
+  std::vector<double> ys = {1.0, 5.0, 2.0};
+  gp.Fit(xs, ys);
+  double mean, var;
+  gp.Predict({0.5}, mean, var);
+  // normalized target: observed best maps to the top of the z-range
+  CHECK(std::abs(mean - (5.0 - (8.0 / 3.0)) / std::sqrt(8.667 / 3.0)) < 0.2);
+  CHECK(var < 0.1);
+  double ei_near_best = gp.ExpectedImprovement({0.55});
+  double ei_far_low = gp.ExpectedImprovement({0.1});
+  CHECK(ei_near_best > ei_far_low);
+}
+
+static void TestParameterManagerConverges() {
+  // Synthetic objective over (fusion, cycle): unimodal peak at
+  // fusion = 2^22, cycle = 5ms. The tuner must finish its budget,
+  // report the best-seen params, and write a parseable log.
+  ParameterManager pm;
+  ParameterManager::Options po;
+  po.enabled = true;
+  po.warmup_samples = 1;
+  po.cycles_per_sample = 2;
+  po.max_samples = 16;
+  po.gp_noise = 1e-3;
+  pm.Initialize(po, 64 << 20, 1.0);
+  auto score = [](int64_t fusion, double cycle_ms) {
+    double lf = std::log2(static_cast<double>(fusion));
+    return 1e9 * std::exp(-0.1 * (lf - 22) * (lf - 22)) *
+           std::exp(-0.05 * (cycle_ms - 5) * (cycle_ms - 5));
+  };
+  int guard = 0;
+  while (pm.active() && ++guard < 10000) {
+    // feed: bytes/elapsed == score at the currently proposed params
+    double s = score(pm.fusion_threshold(), pm.cycle_time_ms());
+    pm.Update(static_cast<int64_t>(s), 1.0);
+  }
+  CHECK(pm.done());
+  CHECK(pm.samples() == po.max_samples);
+  CHECK(pm.best_score() > 0);
+  // converged params are the best observed sample
+  CHECK(std::abs(score(pm.best_fusion_threshold(),
+                       pm.best_cycle_time_ms()) -
+                 pm.best_score()) < 1e-3 * pm.best_score());
+  // current (adopted) params equal the best after convergence
+  CHECK(pm.fusion_threshold() == pm.best_fusion_threshold());
+  CHECK(pm.cycle_time_ms() == pm.best_cycle_time_ms());
+}
+
 int main() {
   TestMessageRoundtrip();
+  TestGaussianProcessEI();
+  TestParameterManagerConverges();
   TestNegotiatorReadiness();
   TestNegotiatorValidation();
   TestJoinReadiness();

@@ -50,7 +50,8 @@ from horovod_tpu.ops.collective import (
     mesh_size,
 )
 from horovod_tpu.ops.compression import Compression
-from horovod_tpu.ops.fusion import fused_allreduce
+from horovod_tpu.ops.fusion import (autotune_fusion_threshold,
+                                    fused_allreduce)
 from horovod_tpu.hvd_jax import (
     DistributedOptimizer,
     DistributedGradientTransform,
@@ -72,7 +73,7 @@ __all__ = [
     "Sum", "Average", "Adasum", "Min", "Max",
     "allreduce", "allgather", "broadcast", "reducescatter", "alltoall",
     "mesh_rank", "mesh_size",
-    "Compression", "fused_allreduce",
+    "Compression", "fused_allreduce", "autotune_fusion_threshold",
     "DistributedOptimizer", "DistributedGradientTransform",
     "distributed_grad", "distributed_value_and_grad",
     "broadcast_variables", "broadcast_parameters",

@@ -77,6 +77,9 @@ def _load():
     lib.hvdc_last_error.restype = ctypes.c_char_p
     lib.hvdc_output_size.restype = ctypes.c_int64
     lib.hvdc_copy_output.argtypes = [ctypes.c_int, ctypes.c_void_p]
+    lib.hvdc_autotune_state.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     _lib = lib
     return lib
 
@@ -108,6 +111,14 @@ def shutdown():
 
 def is_initialized():
     return _lib is not None and bool(_lib.hvdc_is_initialized())
+
+
+def rank():
+    return _lib.hvdc_rank() if _lib is not None else -1
+
+
+def size():
+    return _lib.hvdc_size() if _lib is not None else -1
 
 
 class Handle:
@@ -220,3 +231,21 @@ def barrier():
     lib = _load()
     if lib.hvdc_barrier() != 0:
         raise RuntimeError("barrier failed")
+
+
+def autotune_state():
+    """Autotuner snapshot: dict with ``enabled``, current
+    ``fusion_threshold`` / ``cycle_time_ms``, coordinator-side ``samples``
+    (-1 on workers) and ``done`` (reference: parameter_manager state)."""
+    lib = _load()
+    fusion = ctypes.c_int64(0)
+    cycle = ctypes.c_double(0.0)
+    samples = ctypes.c_int(0)
+    done = ctypes.c_int(0)
+    rv = lib.hvdc_autotune_state(ctypes.byref(fusion), ctypes.byref(cycle),
+                                 ctypes.byref(samples), ctypes.byref(done))
+    if rv < 0:
+        raise RuntimeError("native core is not initialized")
+    return {"enabled": bool(rv), "fusion_threshold": fusion.value,
+            "cycle_time_ms": cycle.value, "samples": samples.value,
+            "done": bool(done.value)}

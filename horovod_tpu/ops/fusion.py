@@ -18,6 +18,7 @@ executable.
 """
 
 import dataclasses
+import time
 
 import jax
 import jax.numpy as jnp
@@ -131,3 +132,70 @@ def fused_allreduce(tree, op=collective.Average, axes=None,
         for i, arr in _unpack(bucket, flat).items():
             new_leaves[i] = arr.astype(jnp.asarray(leaves[i]).dtype)
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
+                              candidates=None, trials=10, apply=True):
+    """Pick the fusion bucket threshold by timed trials at init.
+
+    The compiled-path analogue of the reference autotuner's
+    fusion-threshold search (``parameter_manager.h:186-220``): on TPU the
+    fused set is static per executable, so instead of online Bayesian
+    optimization over cycles, we compile one executable per candidate
+    threshold, time the fused allreduce of the actual gradient pytree on
+    the real mesh, and keep the fastest. With ``apply=True`` (default)
+    the winner becomes the process-wide default ``fusion_threshold`` used
+    by ``fused_allreduce`` / ``DistributedOptimizer``.
+
+    Returns ``(best_threshold_bytes, {threshold: seconds})``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import basics
+    from horovod_tpu.parallel import mesh as mesh_lib
+
+    if candidates is None:
+        candidates = [1 << 20, 4 << 20, 16 << 20, 64 << 20]
+    try:
+        mesh = mesh_lib.get_mesh()
+    except RuntimeError:
+        mesh = None
+    axes_t = collective._resolve_axes(axes) if mesh is not None else axes
+
+    timings = {}
+    for thr in candidates:
+        def f(t, _thr=thr):
+            return fused_allreduce(t, op=op, axes=axes_t,
+                                   threshold_bytes=_thr)
+        if mesh is not None:
+            spec = jax.tree_util.tree_map(lambda _: P(), tree)
+            f = jax.shard_map(f, mesh=mesh, in_specs=(spec,),
+                              out_specs=spec, check_vma=False)
+        jf = jax.jit(f)
+        out = jf(tree)
+        jax.block_until_ready(out)  # compile outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = jf(tree)
+        jax.block_until_ready(out)
+        timings[thr] = time.perf_counter() - t0
+
+    # Multi-process: every rank must install the SAME winner, or ranks
+    # would plan different bucket structures and emit mismatched
+    # collectives. Sum the timings across ranks, then argmin — a
+    # deterministic, globally identical choice.
+    from horovod_tpu import _core
+    if _core.is_initialized() and _core.size() > 1:
+        vals = np.asarray([timings[c] for c in candidates], np.float64)
+        n = _AUTOTUNE_CALLS.setdefault("n", 0)
+        _AUTOTUNE_CALLS["n"] = n + 1
+        summed = _core.allreduce(vals, f"autotune.fusion.{n}", op="sum")
+        timings = {c: float(s) for c, s in zip(candidates, summed)}
+
+    best = min(timings, key=timings.get)
+    if apply and basics._state.config is not None:
+        basics._state.config.fusion_threshold = best
+    return best, timings
+
+
+_AUTOTUNE_CALLS = {}

@@ -176,6 +176,29 @@ def scenario_timeline(rank, size):
     core.barrier()
 
 
+def scenario_autotune(rank, size):
+    """Run enough allreduces for the Bayesian-opt loop to exhaust its
+    sample budget; every rank must end on the coordinator's winning
+    (fusion threshold, cycle time)."""
+    x = np.ones(1024, dtype=np.float32)
+    for i in range(80):
+        core.allreduce(x.copy(), f"at.{i % 4}", op="sum")
+    st = core.autotune_state()
+    assert st["enabled"], st
+    if rank == 0:
+        assert st["done"], f"tuner did not converge: {st}"
+        assert st["samples"] >= int(
+            os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"]), st
+    # tuned values must be inside the tuning bounds
+    assert 2 ** 16 <= st["fusion_threshold"] <= 2 ** 26, st
+    assert 0.5 <= st["cycle_time_ms"] <= 25.0, st
+    # one more negotiated cycle so workers definitely saw the final values
+    core.barrier()
+    st = core.autotune_state()
+    print("TUNED", json.dumps([st["fusion_threshold"],
+                               round(st["cycle_time_ms"], 6)]))
+
+
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))

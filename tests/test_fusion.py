@@ -117,6 +117,29 @@ def test_fused_allreduce_empty_tree(hvd):
     assert fusion.fused_allreduce({}) == {}
 
 
+def test_autotune_fusion_threshold(hvd):
+    """Timed-trial bucket autotune: returns a candidate, times every
+    candidate, and installs the winner as the process default."""
+    tree = {"a": jnp.ones((512,)), "b": jnp.ones((256,)),
+            "c": jnp.ones((64, 8))}
+    candidates = [1 << 10, 1 << 20]
+    best, timings = fusion.autotune_fusion_threshold(
+        tree, candidates=candidates, trials=2)
+    assert best in candidates
+    assert set(timings) == set(candidates)
+    assert all(t > 0 for t in timings.values())
+    from horovod_tpu import basics
+    assert basics._state.config.fusion_threshold == best
+    # the tuned default now drives fused_allreduce's bucket planning
+    out = jax.shard_map(
+        lambda t: fusion.fused_allreduce(t, op=hvd_api.Sum),
+        mesh=hvd.mesh(), in_specs=(jax.tree_util.tree_map(
+            lambda _: P(), tree),),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), tree),
+        check_vma=False)(tree)
+    np.testing.assert_allclose(out["a"], 8.0 * np.ones((512,)), rtol=1e-6)
+
+
 def test_one_collective_per_bucket(hvd):
     """The fused path must emit exactly one all-reduce per dtype bucket
     (the whole point of fusion — reference fuses to one NCCL call per

@@ -58,6 +58,32 @@ def test_errors_negotiated(tmp_path):
     _run_workers("errors", 2)
 
 
+def test_autotune_converges_and_syncs(tmp_path):
+    """hvdrun --autotune end-to-end: the coordinator's BO loop converges
+    within its sample budget and every rank adopts identical tuned
+    parameters (reference parameter_manager + SynchronizeParameters)."""
+    log = tmp_path / "autotune.csv"
+    results = _run_workers("autotune", 4, env_extra={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": str(log),
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "2",
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "8",
+    }, timeout=180)
+    import json as _json
+    tuned = []
+    for out, _ in results:
+        line = [l for l in out.splitlines() if l.startswith("TUNED ")][0]
+        tuned.append(tuple(_json.loads(line[len("TUNED "):])))
+    assert len(set(tuned)) == 1, f"ranks disagree on tuned params: {tuned}"
+    # --autotune-log-file wrote header + per-sample rows + converged row
+    lines = log.read_text().strip().splitlines()
+    assert lines[0].startswith("sample,fusion_threshold,cycle_time_ms")
+    assert any(l.startswith("converged,") for l in lines)
+    assert len([l for l in lines if not l.startswith(("sample", "converged"))
+                ]) >= 8
+
+
 def test_join_uneven_ranks():
     _run_workers("join", 4)
 
