@@ -10,6 +10,7 @@
 #ifndef HVD_CONTROLLER_H
 #define HVD_CONTROLLER_H
 
+#include <atomic>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -55,6 +56,12 @@ class ControlPlane {
   // reference controller.h:47-49 CrossRankBitwiseAnd/Or).
   Status BitAllreduce(std::vector<uint64_t>& bits, bool is_and);
 
+  // Control-plane traffic accounting for the negotiation round methods
+  // (the response-cache protocol exists to shrink these). Atomics: the
+  // loop thread writes while user threads read.
+  int64_t round_bytes_sent() const { return round_bytes_sent_.load(); }
+  int64_t round_bytes_recv() const { return round_bytes_recv_.load(); }
+
  private:
   Status EnsureConnected();
   // gather variable-size frames from all ranks to rank 0
@@ -70,6 +77,8 @@ class ControlPlane {
   std::vector<std::unique_ptr<TcpConnection>> workers_;  // coordinator only
   std::unique_ptr<TcpConnection> coord_;              // workers only
   std::mutex mu_;
+  std::atomic<int64_t> round_bytes_sent_{0};
+  std::atomic<int64_t> round_bytes_recv_{0};
 };
 
 }  // namespace hvd

@@ -38,6 +38,14 @@ Status RingAllreduce(PeerMesh& mesh, int rank, int size, void* data,
 
 // Variable-size allgather: rank r contributes counts[r] elements; output
 // holds the concatenation in rank order (reference MPI_Allgatherv).
+// Ring reduce-scatter: the bandwidth-optimal first half of the ring
+// allreduce, exposed as its own op (each rank sends ~1/N of allreduce's
+// traffic and receives its `counts[rank]`-element slice of the reduction
+// into `output`). `data` is clobbered as scratch.
+Status RingReduceScatter(PeerMesh& mesh, int rank, int size, void* data,
+                         const std::vector<int64_t>& counts, DataType dtype,
+                         ReduceOp op, void* output);
+
 Status RingAllgatherv(PeerMesh& mesh, int rank, int size, const void* input,
                       const std::vector<int64_t>& counts, DataType dtype,
                       void* output);

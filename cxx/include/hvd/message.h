@@ -121,6 +121,11 @@ struct Response {
 struct RequestList {
   std::vector<Request> requests;
   bool shutdown = false;
+  // steady-state announcement: packed bit per cache position this rank
+  // has ready with cache-identical parameters (reference
+  // response_cache.h:107-167 CacheCoordinator bits). Tensors announced
+  // here do NOT appear in `requests` — that is the bytes saving.
+  std::vector<uint64_t> cache_bits;
 
   std::vector<uint8_t> Serialize() const;
   static RequestList Deserialize(const std::vector<uint8_t>& buf);
@@ -134,6 +139,16 @@ struct ResponseList {
   bool has_tuned_params = false;
   int64_t tuned_fusion_threshold = 0;
   double tuned_cycle_time_ms = 0;  // serialized bit-exactly
+
+  // steady-state decision: bit positions every (non-joined) rank
+  // announced as cache hits — each rank reconstructs those responses
+  // from its local cache replica instead of receiving them in
+  // `responses`. `cache_invalid` orders an eviction (a rank's params
+  // changed); evicted tensors renegotiate via the full path.
+  std::vector<uint64_t> cache_hits;
+  std::vector<uint32_t> cache_invalid;
+  // AVERAGE divisor for reconstructed cache-hit responses (size - joined)
+  int32_t active_ranks = 0;
 
   std::vector<uint8_t> Serialize() const;
   static ResponseList Deserialize(const std::vector<uint8_t>& buf);

@@ -51,6 +51,11 @@ int hvdc_barrier();
 int hvdc_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms,
                         int* samples, int* done);
 
+// Cumulative control-plane bytes this rank has sent/received in
+// negotiation rounds (the response-cache bitvector protocol exists to
+// shrink these in steady state). Returns 0 on success.
+int hvdc_control_bytes(int64_t* sent, int64_t* recvd);
+
 }  // extern "C"
 
 #endif  // HVD_OPERATIONS_H

@@ -29,9 +29,15 @@ class ResponseCache {
   // INVALID when cached with different shape/dtype/op (must renegotiate
   // and evict).
   CacheState Cached(const Request& req) const;
-  void Put(const Request& req, const Response& resp);
+  // Insert/update. Returns the name evicted to make room ("" if none) —
+  // callers tracking bit-announced tensors must requeue an evicted one.
+  std::string Put(const Request& req, const Response& resp);
   const Response& Get(const std::string& name);
   uint32_t GetBit(const std::string& name) const;
+  // Name currently holding `bit`, or "" if the bit is unassigned.
+  std::string NameForBit(uint32_t bit) const;
+  // Cached response type for a bit (ERROR if unassigned).
+  Response::Type TypeForBit(uint32_t bit) const;
   void Erase(const std::string& name);
   size_t size() const { return entries_.size(); }
   size_t capacity() const { return capacity_; }

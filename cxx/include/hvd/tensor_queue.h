@@ -40,6 +40,9 @@ class TensorQueue {
   // Drain all pending requests for this cycle (reference
   // PopMessagesFromQueue).
   std::vector<Request> PopRequests();
+  // Put a request back at the head of the FIFO (cache invalidation:
+  // a tensor announced via the bitvector must renegotiate in full).
+  void Requeue(const Request& req);
   // Remove and return the entry for a negotiated tensor.
   bool Take(const std::string& name, TensorTableEntry& out);
   // Names currently pending (for the stall inspector).

@@ -128,13 +128,16 @@ Status ControlPlane::BcastFrame(std::vector<uint8_t>& bytes, int root) {
 Status ControlPlane::SendReadyTensors(const RequestList& reqs) {
   Status s = EnsureConnected();
   if (!s.ok()) return s;
-  return coord_->SendFrame(reqs.Serialize());
+  auto bytes = reqs.Serialize();
+  round_bytes_sent_ += static_cast<int64_t>(bytes.size()) + 4;
+  return coord_->SendFrame(bytes);
 }
 
 Status ControlPlane::RecvFinalTensors(ResponseList& resp) {
   std::vector<uint8_t> buf;
   Status s = coord_->RecvFrame(buf);
   if (!s.ok()) return s;
+  round_bytes_recv_ += static_cast<int64_t>(buf.size()) + 4;
   resp = ResponseList::Deserialize(buf);
   return Status::OK();
 }
@@ -147,6 +150,7 @@ Status ControlPlane::RecvReadyTensors(std::vector<RequestList>& per_rank) {
     std::vector<uint8_t> buf;
     s = workers_[i]->RecvFrame(buf);
     if (!s.ok()) return s;
+    round_bytes_recv_ += static_cast<int64_t>(buf.size()) + 4;
     per_rank[i] = RequestList::Deserialize(buf);
   }
   return Status::OK();
@@ -154,6 +158,8 @@ Status ControlPlane::RecvReadyTensors(std::vector<RequestList>& per_rank) {
 
 Status ControlPlane::SendFinalTensors(const ResponseList& resp) {
   auto bytes = resp.Serialize();
+  round_bytes_sent_ +=
+      (static_cast<int64_t>(bytes.size()) + 4) * (size_ - 1);
   for (int i = 1; i < size_; ++i) {
     Status s = workers_[i]->SendFrame(bytes);
     if (!s.ok()) return s;

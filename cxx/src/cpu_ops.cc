@@ -1,5 +1,6 @@
 #include "hvd/cpu_ops.h"
 
+#include <algorithm>
 #include <cmath>
 #include <cstring>
 
@@ -170,37 +171,57 @@ Status RingAllreduce(PeerMesh& mesh, int rank, int size, void* data,
   if (size == 1) {
     return Status::OK();
   }
+  // ring allreduce = ring reduce-scatter (rank r ends owning reduced
+  // chunk r) + ring allgatherv of the owned chunks — one implementation
+  // of the N-1-step reduce schedule, shared with the standalone
+  // reduce-scatter op.
   size_t esz = DataTypeSize(dtype);
-  uint8_t* bytes = static_cast<uint8_t*>(data);
   Chunks ch(count, size);
-  std::vector<uint8_t> tmp((ch.base + (ch.rem ? 1 : 0)) * esz);
-  int next = (rank + 1) % size;
-  int prev = (rank - 1 + size) % size;
-
-  // reduce-scatter: after N-1 steps rank r owns the full reduction of
-  // chunk (r+1) % N
-  for (int s = 0; s < size - 1; ++s) {
-    int send_c = (rank - s + size) % size;
-    int recv_c = (rank - s - 1 + size) % size;
-    Status st = mesh.RingStep(next, prev, bytes + ch.start(send_c) * esz,
-                              ch.len(send_c) * esz, tmp.data(),
-                              ch.len(recv_c) * esz);
-    if (!st.ok()) return st;
-    ReduceInto(bytes + ch.start(recv_c) * esz, tmp.data(), ch.len(recv_c),
-               dtype, op);
-  }
-  // allgather rotation
-  for (int s = 0; s < size - 1; ++s) {
-    int send_c = (rank + 1 - s + size) % size;
-    int recv_c = (rank - s + size) % size;
-    Status st = mesh.RingStep(next, prev, bytes + ch.start(send_c) * esz,
-                              ch.len(send_c) * esz,
-                              bytes + ch.start(recv_c) * esz,
-                              ch.len(recv_c) * esz);
-    if (!st.ok()) return st;
-  }
+  std::vector<int64_t> counts(size);
+  for (int i = 0; i < size; ++i) counts[i] = ch.len(i);
+  ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
+  std::vector<uint8_t> own(counts[rank] * esz);
+  Status st = RingReduceScatter(mesh, rank, size, data, counts, dtype,
+                                wire_op, own.data());
+  if (!st.ok()) return st;
+  st = RingAllgatherv(mesh, rank, size, own.data(), counts, dtype, data);
+  if (!st.ok()) return st;
   if (op == ReduceOp::AVERAGE)
     ScaleInPlace(data, count, dtype, 1.0 / size);
+  return Status::OK();
+}
+
+Status RingReduceScatter(PeerMesh& mesh, int rank, int size, void* data,
+                         const std::vector<int64_t>& counts, DataType dtype,
+                         ReduceOp op, void* output) {
+  size_t esz = DataTypeSize(dtype);
+  uint8_t* bytes = static_cast<uint8_t*>(data);
+  std::vector<int64_t> displs(size, 0);
+  for (int i = 1; i < size; ++i) displs[i] = displs[i - 1] + counts[i - 1];
+
+  if (size > 1) {
+    int64_t max_count = 0;
+    for (int64_t c : counts) max_count = std::max(max_count, c);
+    std::vector<uint8_t> tmp(max_count * esz);
+    int next = (rank + 1) % size;
+    int prev = (rank - 1 + size) % size;
+    // schedule shifted one chunk vs the allreduce phase so rank r ends
+    // owning chunk r (not r+1): step s sends chunk (r-s-1), reduces
+    // chunk (r-s-2); after N-1 steps the fully reduced chunk is r's own.
+    for (int s = 0; s < size - 1; ++s) {
+      int send_c = (rank - s - 1 + 2 * size) % size;
+      int recv_c = (rank - s - 2 + 2 * size) % size;
+      Status st = mesh.RingStep(next, prev, bytes + displs[send_c] * esz,
+                                counts[send_c] * esz, tmp.data(),
+                                counts[recv_c] * esz);
+      if (!st.ok()) return st;
+      ReduceInto(bytes + displs[recv_c] * esz, tmp.data(), counts[recv_c],
+                 dtype, op);
+    }
+  }
+  std::memcpy(output, bytes + displs[rank] * esz, counts[rank] * esz);
+  if (op == ReduceOp::AVERAGE)
+    ScaleInPlace(output, counts[rank], dtype, 1.0 / size);
   return Status::OK();
 }
 

@@ -89,9 +89,31 @@ Response Response::Deserialize(Reader& r) {
   return p;
 }
 
+namespace {
+
+// cache bitvectors are sparse in practice; trailing zero words elided
+void WriteBits(Writer& w, const std::vector<uint64_t>& bits) {
+  size_t n = bits.size();
+  while (n > 0 && bits[n - 1] == 0) --n;
+  w.i32(static_cast<int32_t>(n));
+  for (size_t i = 0; i < n; ++i)
+    w.i64(static_cast<int64_t>(bits[i]));
+}
+
+std::vector<uint64_t> ReadBits(Reader& r) {
+  int32_t n = r.i32();
+  std::vector<uint64_t> bits(n);
+  for (int32_t i = 0; i < n; ++i)
+    bits[i] = static_cast<uint64_t>(r.i64());
+  return bits;
+}
+
+}  // namespace
+
 std::vector<uint8_t> RequestList::Serialize() const {
   Writer w;
   w.u8(shutdown ? 1 : 0);
+  WriteBits(w, cache_bits);
   w.i32(static_cast<int32_t>(requests.size()));
   for (const auto& q : requests) q.Serialize(w);
   return w.take();
@@ -101,6 +123,7 @@ RequestList RequestList::Deserialize(const std::vector<uint8_t>& buf) {
   Reader r(buf);
   RequestList l;
   l.shutdown = r.u8() != 0;
+  l.cache_bits = ReadBits(r);
   int32_t n = r.i32();
   l.requests.reserve(n);
   for (int32_t i = 0; i < n; ++i)
@@ -114,6 +137,10 @@ std::vector<uint8_t> ResponseList::Serialize() const {
   w.u8(has_tuned_params ? 1 : 0);
   w.i64(tuned_fusion_threshold);
   w.i64(DoubleBits(tuned_cycle_time_ms));
+  WriteBits(w, cache_hits);
+  w.i32(static_cast<int32_t>(cache_invalid.size()));
+  for (uint32_t b : cache_invalid) w.i32(static_cast<int32_t>(b));
+  w.i32(active_ranks);
   w.i32(static_cast<int32_t>(responses.size()));
   for (const auto& p : responses) p.Serialize(w);
   return w.take();
@@ -126,6 +153,12 @@ ResponseList ResponseList::Deserialize(const std::vector<uint8_t>& buf) {
   l.has_tuned_params = r.u8() != 0;
   l.tuned_fusion_threshold = r.i64();
   l.tuned_cycle_time_ms = BitsToDouble(r.i64());
+  l.cache_hits = ReadBits(r);
+  int32_t ninv = r.i32();
+  l.cache_invalid.reserve(ninv);
+  for (int32_t i = 0; i < ninv; ++i)
+    l.cache_invalid.push_back(static_cast<uint32_t>(r.i32()));
+  l.active_ranks = r.i32();
   int32_t n = r.i32();
   l.responses.reserve(n);
   for (int32_t i = 0; i < n; ++i)

@@ -1,5 +1,6 @@
 #include "hvd/operations.h"
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -128,6 +129,18 @@ struct Global {
   bool self_joined = false;
   int join_handle = -1;
   std::mutex join_mu;
+
+  // steady-state cache protocol: every rank keeps an IDENTICAL cache
+  // replica (driven by the broadcast ResponseList), announces repeat
+  // tensors as bits, and reconstructs hit responses locally.
+  std::unordered_map<std::string, Request> negotiating;    // full requests
+  std::unordered_map<std::string, Request> cache_pending;  // bit-announced
+  // coordinator watchdog: first time a bit was seen set by only a subset
+  // of ranks (a stale hit must eventually renegotiate via the full path
+  // so the stall inspector can see it)
+  std::unordered_map<uint32_t, std::chrono::steady_clock::time_point>
+      partial_bits;
+  double cache_stall_sec = 60.0;
 
   std::string last_error;
 };
@@ -259,6 +272,41 @@ void ExecuteAlltoall(const Response& resp) {
   CompleteEntry(e, st);
 }
 
+void ExecuteReduceScatter(const Response& resp) {
+  TensorTableEntry e;
+  if (!g->queue.Take(resp.tensor_names[0], e)) return;
+  size_t esz = DataTypeSize(resp.dtype);
+  // split along dim 0 like the compiled path (lax.psum_scatter on dim 0):
+  // rank i gets rows [i*base + min(i, rem), ...) — remainder rows go to
+  // the first `rem` ranks
+  int64_t d0 = e.shape.ndim() > 0 ? e.shape.dim(0) : 1;
+  int64_t row = 1;
+  for (int d = 1; d < e.shape.ndim(); ++d) row *= e.shape.dim(d);
+  std::vector<int64_t> counts(g->size);
+  int64_t base = d0 / g->size, rem = d0 % g->size;
+  for (int i = 0; i < g->size; ++i)
+    counts[i] = (base + (i < rem ? 1 : 0)) * row;
+
+  if (e.prescale != 1.0)
+    ScaleInPlace(e.data.data(), e.shape.num_elements(), resp.dtype,
+                 e.prescale);
+  ReduceOp op = static_cast<ReduceOp>(resp.reduce_op);
+  ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
+  std::vector<uint8_t> out(counts[g->rank] * esz);
+  g->timeline.ActivityStart(e.name, "RING_REDUCESCATTER");
+  Status st = RingReduceScatter(*g->mesh, g->rank, g->size, e.data.data(),
+                                counts, resp.dtype, wire_op, out.data());
+  g->timeline.ActivityEnd(e.name);
+  if (st.ok() && op == ReduceOp::AVERAGE) {
+    int active = resp.active_ranks > 0 ? resp.active_ranks : g->size;
+    ScaleInPlace(out.data(), counts[g->rank], resp.dtype, 1.0 / active);
+  }
+  if (st.ok() && e.postscale != 1.0)
+    ScaleInPlace(out.data(), counts[g->rank], resp.dtype, e.postscale);
+  e.data = std::move(out);
+  CompleteEntry(e, st);
+}
+
 void ExecuteBarrier(const Response& resp) {
   TensorTableEntry e;
   bool have = g->queue.Take(resp.tensor_names[0], e);
@@ -292,9 +340,7 @@ void ExecuteResponse(const Response& resp) {
       ExecuteAlltoall(resp);
       break;
     case Response::REDUCESCATTER:
-      // host path executes as allreduce; callers slice (XLA path has the
-      // real reduce-scatter)
-      ExecuteFusedAllreduce(resp);
+      ExecuteReduceScatter(resp);
       break;
     case Response::BARRIER:
       ExecuteBarrier(resp);
@@ -346,46 +392,102 @@ ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
       if (seen.insert(name).second) ready.push_back(name);
   }
 
+  // cache-bit coordination (reference CacheCoordinator::sync,
+  // response_cache.h:107-167): a bit survives only when every non-joined
+  // rank announced it; a full request for a cached name orders a global
+  // eviction (that rank's parameters changed). Joined ranks contribute
+  // implicit all-ones (they zero-fill every tensor).
+  if (g->size > 1) {
+    std::unordered_set<uint32_t> invalid;
+    for (int r = 0; r < g->size; ++r)
+      for (const auto& q : per_rank[r].requests)
+        if (q.type != Request::JOIN &&
+            g->cache.Cached(q) != ResponseCache::CacheState::MISS)
+          invalid.insert(g->cache.GetBit(q.tensor_name));
+
+    size_t words = g->cache.NumBitWords();
+    std::vector<uint64_t> all_and(words, ~uint64_t{0});
+    std::vector<uint64_t> any_or(words, 0);
+    int contributors = 0;
+    for (int r = 0; r < g->size; ++r) {
+      if (g->joined_ranks[r]) continue;
+      ++contributors;
+      const auto& bits = per_rank[r].cache_bits;
+      for (size_t w = 0; w < words; ++w) {
+        uint64_t b = w < bits.size() ? bits[w] : 0;
+        all_and[w] &= b;
+        any_or[w] |= b;
+      }
+    }
+    if (contributors == 0) all_and.assign(words, 0);
+
+    // stale-hit watchdog: a bit some (not all) ranks keep announcing
+    // must eventually renegotiate in full so the stall inspector can
+    // name the missing ranks
+    auto now = std::chrono::steady_clock::now();
+    std::unordered_set<uint32_t> partial_now;
+    for (size_t w = 0; w < words; ++w) {
+      for (uint64_t word = any_or[w] & ~all_and[w]; word;) {
+        int b = __builtin_ctzll(word);
+        word &= word - 1;
+        partial_now.insert(static_cast<uint32_t>(w * 64 + b));
+      }
+    }
+    for (auto it = g->partial_bits.begin(); it != g->partial_bits.end();) {
+      if (!partial_now.count(it->first)) {
+        it = g->partial_bits.erase(it);
+      } else if (std::chrono::duration<double>(now - it->second).count() >
+                 g->cache_stall_sec) {
+        invalid.insert(it->first);
+        it = g->partial_bits.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (uint32_t b : partial_now)
+      if (!invalid.count(b)) g->partial_bits.emplace(b, now);
+
+    // joined ranks cannot satisfy broadcast/alltoall/reducescatter even
+    // on the hit path — force those back through the full path, which
+    // produces the proper ERROR response (guard below at the
+    // ready-tensor loop)
+    if (JoinedCount() > 0) {
+      for (size_t w = 0; w < words; ++w) {
+        for (uint64_t word = all_and[w]; word;) {
+          int b = __builtin_ctzll(word);
+          word &= word - 1;
+          uint32_t bit = static_cast<uint32_t>(w * 64 + b);
+          Response::Type t = g->cache.TypeForBit(bit);
+          if (t == Response::BROADCAST || t == Response::ALLTOALL ||
+              t == Response::REDUCESCATTER)
+            invalid.insert(bit);
+        }
+      }
+    }
+
+    for (uint32_t b : invalid)
+      if (b / 64 < words) all_and[b / 64] &= ~(uint64_t{1} << (b % 64));
+
+    rl.cache_hits = std::move(all_and);
+    rl.cache_invalid.assign(invalid.begin(), invalid.end());
+    std::sort(rl.cache_invalid.begin(), rl.cache_invalid.end());
+  }
+
   int active = g->size - JoinedCount();
+  rl.active_ranks = active;
   for (const auto& name : ready) {
     g->timeline.NegotiateEnd(name);
-    Response r;
-    // steady-state fast path: identical-parameter repeats reuse the cached
-    // validated response (reference response_cache.h:45-102; the
-    // bitvector short-circuit of the full protocol maps onto our
-    // synchronous rounds as a validation skip). A HIT requires EVERY
-    // rank's request to match the cached params — checking one rank would
-    // skip the cross-rank agreement guarantee.
-    const std::vector<Request>* reqs = g->negotiator.Requests(name);
-    bool all_hit = reqs != nullptr && !reqs->empty();
-    if (all_hit)
-      for (const Request& q : *reqs)
-        if (g->cache.Cached(q) != ResponseCache::CacheState::HIT) {
-          all_hit = false;
-          break;
-        }
-    if (all_hit) {
-      r = g->cache.Get(name);
-      g->negotiator.Drop(name);
-    } else {
-      Request params =
-          (reqs && !reqs->empty()) ? (*reqs)[0] : Request{};
-      g->cache.Erase(name);  // params changed (or never cached)
-      r = g->negotiator.BuildResponse(name);
-      // allgather responses embed per-rank dims that may change step to
-      // step; never cache them
-      if (r.type != Response::ERROR && r.type != Response::ALLGATHER)
-        g->cache.Put(params, r);
-    }
+    Response r = g->negotiator.BuildResponse(name);
     r.active_ranks = active;
     // allgather/broadcast/alltoall cannot zero-fill for joined ranks
     // (reference restriction, controller.cc:443-447,523-527)
     if (active < g->size &&
         (r.type == Response::ALLGATHER || r.type == Response::BROADCAST ||
-         r.type == Response::ALLTOALL)) {
+         r.type == Response::ALLTOALL ||
+         r.type == Response::REDUCESCATTER)) {
       r.error_message = "tensor " + r.tensor_names[0] +
-                        ": allgather/broadcast/alltoall are not supported "
-                        "after a rank has joined";
+                        ": allgather/broadcast/alltoall/reducescatter are "
+                        "not supported after a rank has joined";
       r.type = Response::ERROR;
     }
     rl.responses.push_back(std::move(r));
@@ -419,12 +521,12 @@ ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
   return rl;
 }
 
-// Payload bytes a ResponseList moves through the data plane (the
-// autotuner's score numerator, reference parameter_manager score =
+// Payload bytes a cycle's executed responses move through the data plane
+// (the autotuner's score numerator, reference parameter_manager score =
 // bytes/sec over sample windows).
-int64_t ResponsePayloadBytes(const ResponseList& rl) {
+int64_t ResponsePayloadBytes(const std::vector<Response>& responses) {
   int64_t bytes = 0;
-  for (const auto& r : rl.responses) {
+  for (const auto& r : responses) {
     if (r.type != Response::ALLREDUCE && r.type != Response::ADASUM &&
         r.type != Response::REDUCESCATTER)
       continue;
@@ -435,9 +537,114 @@ int64_t ResponsePayloadBytes(const ResponseList& rl) {
   return bytes;
 }
 
+bool IsCacheable(Response::Type t) {
+  // allgather embeds per-rank dims that change step to step; barrier
+  // names are unique per call; join/error are control outcomes
+  return t == Response::ALLREDUCE || t == Response::ADASUM ||
+         t == Response::BROADCAST || t == Response::ALLTOALL ||
+         t == Response::REDUCESCATTER;
+}
+
+// Every rank applies the SAME cache mutations in the SAME order, keyed
+// off the broadcast ResponseList — that is what keeps the replicas
+// identical without ever shipping cache state (reference keeps replicas
+// in sync the same way, via the deterministic response stream).
+// Returns the ordered execution list: reconstructed cache hits first
+// (re-fused locally), then the full responses.
+std::vector<Response> BuildExecutionList(ResponseList& rl) {
+  std::vector<Response> exec;
+  if (g->size > 1) {
+    // 1. evictions (a rank's params changed, or a stale partial hit)
+    for (uint32_t bit : rl.cache_invalid) {
+      std::string name = g->cache.NameForBit(bit);
+      if (name.empty()) continue;
+      auto it = g->cache_pending.find(name);
+      if (it != g->cache_pending.end()) {
+        g->queue.Requeue(it->second);  // renegotiate in full next cycle
+        g->cache_pending.erase(it);
+      }
+      g->cache.Erase(name);
+    }
+    // 2. agreed hits, reconstructed from the local replica in bit order
+    std::vector<Response> hits = g->cache.ResponsesForBits(rl.cache_hits);
+    for (auto& r : hits) {
+      g->cache.Get(r.tensor_names[0]);  // LRU touch, replica-identical
+      g->cache_pending.erase(r.tensor_names[0]);
+      r.active_ranks = rl.active_ranks > 0 ? rl.active_ranks : g->size;
+    }
+    hits = Negotiator::Fuse(std::move(hits), g->fusion_threshold);
+    for (auto& r : hits) exec.push_back(std::move(r));
+  }
+  // 3. full responses seed the replica for future hit cycles
+  for (Response& r : rl.responses) {
+    if (g->size > 1 && r.error_message.empty() && IsCacheable(r.type) &&
+        r.type != Response::BARRIER) {
+      for (size_t i = 0; i < r.tensor_names.size(); ++i) {
+        const std::string& name = r.tensor_names[i];
+        Response single;
+        single.type = r.type;
+        single.tensor_names = {name};
+        single.dtype = r.dtype;
+        single.reduce_op = r.reduce_op;
+        single.tensor_sizes =
+            (r.type == Response::ALLREDUCE || r.type == Response::ADASUM)
+                ? std::vector<int64_t>{r.tensor_sizes[i]}
+                : r.tensor_sizes;
+        // Put must run on EVERY rank (a joined rank has no local request
+        // for this tensor but its replica's bit/LRU sequence must still
+        // match everyone else's). Without the real request, synthesize
+        // flat-shape params: the rank's next real request then reads as
+        // INVALID and triggers one clean renegotiation.
+        Request params;
+        auto it = g->negotiating.find(name);
+        if (it != g->negotiating.end()) {
+          params = it->second;
+        } else {
+          params.type = static_cast<Request::Type>(r.type);
+          params.tensor_name = name;
+          params.dtype = r.dtype;
+          params.reduce_op = r.reduce_op;
+          params.shape = TensorShape({single.tensor_sizes[0]});
+        }
+        std::string evicted = g->cache.Put(params, single);
+        if (!evicted.empty()) {
+          // capacity eviction of a tensor some rank may have announced
+          // via bits: requeue ours if pending so it renegotiates
+          auto pit = g->cache_pending.find(evicted);
+          if (pit != g->cache_pending.end()) {
+            g->queue.Requeue(pit->second);
+            g->cache_pending.erase(pit);
+          }
+        }
+      }
+    }
+    for (const auto& name : r.tensor_names) g->negotiating.erase(name);
+    exec.push_back(std::move(r));
+  }
+  return exec;
+}
+
 bool RunLoopOnce() {
   RequestList mine;
-  mine.requests = g->queue.PopRequests();
+  auto popped = g->queue.PopRequests();
+  for (auto& q : popped) {
+    // steady-state split: identical-parameter repeats are announced as
+    // a cache bit; everything else goes the full negotiation path
+    if (g->size > 1 && q.type != Request::BARRIER &&
+        g->cache.Cached(q) == ResponseCache::CacheState::HIT) {
+      g->cache_pending.emplace(q.tensor_name, q);
+      continue;
+    }
+    if (g->size > 1) g->negotiating[q.tensor_name] = q;
+    g->timeline.NegotiateStart(q.tensor_name, RequestTypeName(q.type));
+    mine.requests.push_back(std::move(q));
+  }
+  if (g->size > 1 && !g->cache_pending.empty()) {
+    std::vector<std::string> names;
+    names.reserve(g->cache_pending.size());
+    for (const auto& kv : g->cache_pending) names.push_back(kv.first);
+    mine.cache_bits = g->cache.PackBits(names);
+  }
   {
     std::lock_guard<std::mutex> lock(g->join_mu);
     if (g->self_joined) {
@@ -449,9 +656,6 @@ bool RunLoopOnce() {
     }
   }
   mine.shutdown = g->shutdown_requested.load();
-  for (const auto& q : mine.requests)
-    if (q.type != Request::JOIN)
-      g->timeline.NegotiateStart(q.tensor_name, RequestTypeName(q.type));
 
   ResponseList rl;
   if (g->size == 1) {
@@ -477,7 +681,8 @@ bool RunLoopOnce() {
     }
   }
 
-  for (const auto& resp : rl.responses) {
+  std::vector<Response> exec = BuildExecutionList(rl);
+  for (const auto& resp : exec) {
     g->timeline.Start(resp.tensor_names[0],
                       std::string("OP_") + std::to_string(resp.type));
     ExecuteResponse(resp);
@@ -494,7 +699,7 @@ bool RunLoopOnce() {
     double elapsed =
         std::chrono::duration<double>(now - g->last_cycle_tp).count();
     g->last_cycle_tp = now;
-    int64_t bytes = ResponsePayloadBytes(rl);
+    int64_t bytes = ResponsePayloadBytes(exec);
     if (bytes > 0) {
       std::lock_guard<std::mutex> lock(g->tune_mu);
       g->pm.Update(bytes, elapsed);
@@ -691,6 +896,18 @@ int hvdc_copy_output(int handle, void* dst) {
 
 void hvdc_release(int handle) {
   if (g) g->handles.Release(handle);
+}
+
+int hvdc_control_bytes(int64_t* sent, int64_t* recvd) {
+  if (g == nullptr || !g->initialized.load()) return -1;
+  if (g->control == nullptr) {  // single process: no control plane
+    if (sent) *sent = 0;
+    if (recvd) *recvd = 0;
+    return 0;
+  }
+  if (sent) *sent = g->control->round_bytes_sent();
+  if (recvd) *recvd = g->control->round_bytes_recv();
+  return 0;
 }
 
 int hvdc_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms,

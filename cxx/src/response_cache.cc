@@ -14,18 +14,19 @@ ResponseCache::CacheState ResponseCache::Cached(const Request& req) const {
   return CacheState::INVALID;
 }
 
-void ResponseCache::Put(const Request& req, const Response& resp) {
+std::string ResponseCache::Put(const Request& req, const Response& resp) {
   auto it = entries_.find(req.tensor_name);
   if (it != entries_.end()) {
     it->second.response = resp;
     it->second.params = req;
     Touch(req.tensor_name);
-    return;
+    return {};
   }
+  std::string evicted;
   if (entries_.size() >= capacity_) {
     // evict least-recently-used
-    const std::string& victim = lru_.back();
-    auto vit = entries_.find(victim);
+    evicted = lru_.back();
+    auto vit = entries_.find(evicted);
     free_bits_.push_back(vit->second.bit);
     bit_to_name_.erase(vit->second.bit);
     entries_.erase(vit);
@@ -42,6 +43,7 @@ void ResponseCache::Put(const Request& req, const Response& resp) {
   Entry e{resp, req, bit, lru_.begin()};
   entries_.emplace(req.tensor_name, std::move(e));
   bit_to_name_[bit] = req.tensor_name;
+  return evicted;
 }
 
 const Response& ResponseCache::Get(const std::string& name) {
@@ -51,6 +53,17 @@ const Response& ResponseCache::Get(const std::string& name) {
 
 uint32_t ResponseCache::GetBit(const std::string& name) const {
   return entries_.at(name).bit;
+}
+
+std::string ResponseCache::NameForBit(uint32_t bit) const {
+  auto it = bit_to_name_.find(bit);
+  return it == bit_to_name_.end() ? std::string() : it->second;
+}
+
+Response::Type ResponseCache::TypeForBit(uint32_t bit) const {
+  auto it = bit_to_name_.find(bit);
+  if (it == bit_to_name_.end()) return Response::ERROR;
+  return entries_.at(it->second).response.type;
 }
 
 void ResponseCache::Erase(const std::string& name) {

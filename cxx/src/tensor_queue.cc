@@ -21,6 +21,11 @@ std::vector<Request> TensorQueue::PopRequests() {
   return out;
 }
 
+void TensorQueue::Requeue(const Request& req) {
+  std::lock_guard<std::mutex> lock(mu_);
+  pending_.push_front(req);
+}
+
 bool TensorQueue::Take(const std::string& name, TensorTableEntry& out) {
   std::lock_guard<std::mutex> lock(mu_);
   auto it = table_.find(name);

@@ -80,6 +80,8 @@ def _load():
     lib.hvdc_autotune_state.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.hvdc_control_bytes.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
     _lib = lib
     return lib
 
@@ -204,6 +206,26 @@ def broadcast(array, name, root_rank=0):
     return broadcast_async(array, name, root_rank).wait()
 
 
+def reducescatter_async(array, name, op="sum", prescale=1.0, postscale=1.0):
+    """Reduce across ranks, scatter along dim 0: this rank receives rows
+    [rank*base + min(rank, rem) ...) of the reduction (remainder rows go
+    to the first ranks), matching the compiled path's dim-0 split."""
+    arr = np.ascontiguousarray(array)
+    d0 = arr.shape[0] if arr.ndim > 0 else 1
+    n = _lib.hvdc_size() if _lib is not None and _lib.hvdc_size() > 0 else 1
+    base, rem = divmod(d0, n)
+    r = _lib.hvdc_rank() if _lib is not None else 0
+    rows = base + (1 if r < rem else 0)
+    out_shape = (rows,) + arr.shape[1:]
+    return _enqueue(REDUCESCATTER, name, arr, _OP_MAP[op],
+                    out_shape=out_shape, prescale=prescale,
+                    postscale=postscale)
+
+
+def reducescatter(array, name, op="sum", **kw):
+    return reducescatter_async(array, name, op, **kw).wait()
+
+
 def alltoall_async(array, name):
     arr = np.ascontiguousarray(array)
     return _enqueue(ALLTOALL, name, arr, out_shape=arr.shape)
@@ -231,6 +253,18 @@ def barrier():
     lib = _load()
     if lib.hvdc_barrier() != 0:
         raise RuntimeError("barrier failed")
+
+
+def control_bytes():
+    """Cumulative control-plane bytes (sent, received) in negotiation
+    rounds — the response-cache bitvector protocol shrinks these in
+    steady state."""
+    lib = _load()
+    sent = ctypes.c_int64(0)
+    recvd = ctypes.c_int64(0)
+    if lib.hvdc_control_bytes(ctypes.byref(sent), ctypes.byref(recvd)) != 0:
+        raise RuntimeError("native core is not initialized")
+    return sent.value, recvd.value
 
 
 def autotune_state():

@@ -90,6 +90,23 @@ def scenario_collectives(rank, size):
          for r in range(size)])
     np.testing.assert_array_equal(out, expected)
 
+    # -- reduce-scatter: rows of the sum, split dim 0 with remainder to
+    # the first ranks (NumPy reference slice)
+    xr = (np.arange((size + 1) * 3, dtype=np.float32).reshape(size + 1, 3)
+          * (rank + 1))
+    out = core.reducescatter(xr, "rs.sum", op="sum")
+    full = (np.arange((size + 1) * 3, dtype=np.float32)
+            .reshape(size + 1, 3) * (size * (size + 1) / 2))
+    base, rem = divmod(size + 1, size)
+    start = rank * base + min(rank, rem)
+    rows = base + (1 if rank < rem else 0)
+    np.testing.assert_allclose(out, full[start:start + rows], rtol=1e-6)
+
+    # -- reduce-scatter average
+    xr2 = np.full((size, 2), rank + 1.0, dtype=np.float64)
+    out = core.reducescatter(xr2, "rs.avg", op="average")
+    np.testing.assert_allclose(out, np.full((1, 2), (size + 1) / 2.0))
+
     # -- barrier
     core.barrier()
 
@@ -153,6 +170,33 @@ def scenario_join(rank, size):
         core.join()
 
 
+def scenario_join_cached(rank, size):
+    """Cache + join interplay: a tensor cached by everyone keeps working
+    on the hit path after a rank joins (zero-fill, AND skips joined
+    ranks), and the joined rank's cache replica stays consistent."""
+    x = np.ones(4, dtype=np.float32) * (rank + 1)
+    # two rounds: negotiate + cache, then a pure hit round
+    core.allreduce(x.copy(), "jc.a", op="sum")
+    core.allreduce(x.copy(), "jc.a", op="sum")
+    if rank == size - 1:
+        core.join()
+    else:
+        # cached-tensor allreduce with a joined rank: hit path, zero-fill
+        out = core.allreduce(x.copy(), "jc.a", op="average")
+        expected = sum(range(1, size)) / (size - 1)
+        np.testing.assert_allclose(out, np.ones(4) * expected, rtol=1e-6)
+        # a NEW tensor negotiated while a rank is joined (the joined rank
+        # must keep its replica in sync even without a local request)
+        out = core.allreduce(x.copy(), "jc.b", op="sum")
+        np.testing.assert_allclose(out, np.ones(4) * sum(range(1, size)))
+        core.join()
+    # everyone back: both tensors still usable afterwards
+    out = core.allreduce(x.copy(), "jc.a", op="sum")
+    np.testing.assert_allclose(out, np.ones(4) * size * (size + 1) / 2)
+    out = core.allreduce(x.copy(), "jc.b", op="sum")
+    np.testing.assert_allclose(out, np.ones(4) * size * (size + 1) / 2)
+
+
 def scenario_join_allgather(rank, size):
     # allgather after a rank joined must fail cleanly on every active rank
     # (reference restriction controller.cc:443-447)
@@ -174,6 +218,71 @@ def scenario_timeline(rank, size):
     core.allreduce(x, "tl.a", op="sum")
     core.allreduce(x, "tl.b", op="average")
     core.barrier()
+
+
+def scenario_cache_bytes(rank, size):
+    """Steady-state cache protocol: after warm-up, a 100-tensor workload
+    must ride the bitvector path, cutting control-plane bytes/cycle ~10x
+    (reference response_cache.h:107-167 short-circuit)."""
+    def one_round(tag):
+        handles = [core.allreduce_async(
+            np.full(8, rank + i, dtype=np.float32), f"cb.{i}", op="sum")
+            for i in range(100)]
+        for h in handles:
+            h.wait()
+
+    one_round("warm")   # negotiates + seeds every rank's cache replica
+    core.barrier()
+    s0, r0 = core.control_bytes()
+    one_round("cold-measure")  # second round: params identical -> hits
+    core.barrier()
+    s1, r1 = core.control_bytes()
+    cold = (s1 - s0) + (r1 - r0)
+    for _ in range(3):
+        one_round("hot")
+        core.barrier()
+    s2, r2 = core.control_bytes()
+    hot = ((s2 - s1) + (r2 - r1)) / 3.0
+
+    # The very first round ships 100 full requests (+ responses); hit
+    # rounds ship a few bitvector words. Compare a hit round against the
+    # recorded warm-round traffic.
+    core.barrier()
+    sw, rw = core.control_bytes()
+    # measure a fully-cold equivalent: new names negotiate in full
+    handles = [core.allreduce_async(
+        np.full(8, rank + i, dtype=np.float32), f"cold.{i}", op="sum")
+        for i in range(100)]
+    for h in handles:
+        h.wait()
+    core.barrier()
+    sc, rc = core.control_bytes()
+    full = (sc - sw) + (rc - rw)
+    assert hot * 5 < full, (
+        f"steady-state control bytes not reduced: hit-cycle={hot} "
+        f"full-cycle={full}")
+    # correctness: values still exact on the hit path
+    out = core.allreduce(np.full(4, rank + 1.0, dtype=np.float32),
+                         "cb.check", op="sum")
+    np.testing.assert_allclose(out, np.full(4, size * (size + 1) / 2.0))
+    print("CACHEBYTES", json.dumps([cold, hot, full]))
+
+
+def scenario_cache_invalidation(rank, size):
+    """A tensor renegotiates when its params change (shape here): the
+    coordinator broadcasts an eviction, ranks re-run the full path, and
+    values stay exact."""
+    for step in range(3):
+        x = np.full(4, rank + 1.0, dtype=np.float32)
+        out = core.allreduce(x, "inv.a", op="sum")
+        np.testing.assert_allclose(out, np.full(4, size * (size + 1) / 2.0))
+    # same name, new shape -> INVALID -> evict + renegotiate
+    y = np.full((2, 3), float(rank), dtype=np.float32)
+    out = core.allreduce(y, "inv.a", op="sum")
+    np.testing.assert_allclose(out, np.full((2, 3), size * (size - 1) / 2.0))
+    # and it becomes cacheable again at the new shape
+    out = core.allreduce(y, "inv.a", op="sum")
+    np.testing.assert_allclose(out, np.full((2, 3), size * (size - 1) / 2.0))
 
 
 def scenario_autotune(rank, size):

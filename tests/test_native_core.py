@@ -58,6 +58,17 @@ def test_errors_negotiated(tmp_path):
     _run_workers("errors", 2)
 
 
+@pytest.mark.parametrize("size", [2, 4])
+def test_cache_bitvector_cuts_control_bytes(size):
+    """Steady state rides the hit-bitvector path: control-plane bytes per
+    cycle drop >5x vs full negotiation on a 100-tensor workload."""
+    _run_workers("cache_bytes", size, timeout=180)
+
+
+def test_cache_invalidation_renegotiates():
+    _run_workers("cache_invalidation", 2)
+
+
 def test_autotune_converges_and_syncs(tmp_path):
     """hvdrun --autotune end-to-end: the coordinator's BO loop converges
     within its sample budget and every rank adopts identical tuned
@@ -86,6 +97,13 @@ def test_autotune_converges_and_syncs(tmp_path):
 
 def test_join_uneven_ranks():
     _run_workers("join", 4)
+
+
+@pytest.mark.parametrize("size", [3, 4])
+def test_join_with_cached_tensors(size):
+    """Hit-path tensors survive a rank joining; new tensors negotiated
+    while a rank is joined keep every cache replica in lockstep."""
+    _run_workers("join_cached", size, timeout=120)
 
 
 def test_join_rejects_allgather():
