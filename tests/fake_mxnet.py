@@ -1,0 +1,126 @@
+"""Minimal numpy-backed stand-in for mxnet, enough to exercise the
+horovod_tpu.mxnet adapter logic in-image (mxnet itself is not baked
+into the environment). Mirrors the slivers of API the adapter touches:
+``nd.array``/NDArray with ``asnumpy`` + slice assignment,
+``optimizer.Optimizer``, and a gluon ``Trainer``/``Parameter`` pair.
+"""
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data):
+        self._data = np.array(data, copy=True)
+
+    def asnumpy(self):
+        return self._data.copy()
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        self._data[key] = value
+
+    def __getitem__(self, key):
+        return NDArray(self._data[key])
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @classmethod
+    def from_numpy(cls, arr):
+        return cls(arr)
+
+
+def _nd_array(data, dtype=None, **_):
+    arr = np.array(data)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return NDArray(arr)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01):
+        self.lr = learning_rate
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight.asnumpy() - self.lr * grad.asnumpy()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return self.create_state(index, weight)
+
+
+class Parameter:
+    def __init__(self, name, data, grad_req="write"):
+        self.name = name
+        self.grad_req = grad_req
+        self._data = NDArray(data)
+        self._grad = NDArray(np.zeros_like(self._data.asnumpy()))
+
+    def data(self):
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class Trainer:
+    """Sliver of gluon.Trainer: step() aggregates grads then updates."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        if isinstance(params, dict):
+            params = list(params.values())
+        self._params = list(params)
+        if isinstance(optimizer, str):
+            optimizer = Optimizer(**(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._scale = 1.0
+
+    def _allreduce_grads(self):
+        pass
+
+    def step(self, batch_size):
+        self._allreduce_grads()
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            g = NDArray(p.list_grad()[0].asnumpy() *
+                        (self._scale / batch_size))
+            self._optimizer.update(i, p.data(), g, None)
+
+
+def install():
+    """Install the fake as ``sys.modules['mxnet']`` (idempotent)."""
+    if "mxnet" in sys.modules:
+        return sys.modules["mxnet"]
+    mx = types.ModuleType("mxnet")
+    mx.nd = types.ModuleType("mxnet.nd")
+    mx.nd.array = _nd_array
+    mx.nd.NDArray = NDArray
+    mx.optimizer = types.ModuleType("mxnet.optimizer")
+    mx.optimizer.Optimizer = Optimizer
+    mx.gluon = types.ModuleType("mxnet.gluon")
+    mx.gluon.Trainer = Trainer
+    mx.gluon.Parameter = Parameter
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.nd"] = mx.nd
+    sys.modules["mxnet.optimizer"] = mx.optimizer
+    sys.modules["mxnet.gluon"] = mx.gluon
+    return mx
