@@ -17,6 +17,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
@@ -156,18 +157,50 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
 
     ``tokens`` is ``[B, S]``; B is sharded over ``batch_axis`` and, when
     ``seq_axis`` is set, S over ``seq_axis`` with ring attention inside the
-    model (``cfg.sequence_axis`` must name the same axis). The loss masks
-    each shard's final position locally (targets = tokens shifted within the
-    shard), which approximates full-sequence loss to within S/n boundary
-    tokens — exact loss stitching arrives with the data loader.
+    model (``cfg.sequence_axis`` must name the same axis). The next-token
+    loss is **exact** under sequence sharding: each shard's final position
+    is scored against the first token of the next shard (fetched with one
+    ``ppermute`` over ``seq_axis``), only the global final position is
+    masked, and the mean is normalized by the global target count — so the
+    seq-parallel loss and gradient match the single-device full-sequence
+    computation.
     """
     mesh = mesh if mesh is not None else mesh_lib.get_mesh()
     grad_axes = (batch_axis,) if seq_axis is None else (batch_axis, seq_axis)
+    n_shards = int(np.prod([mesh.shape[a] for a in grad_axes]))
+    n_seq = mesh.shape[seq_axis] if seq_axis else 1
 
     def local_step(state, tokens):
+        if seq_axis is not None and n_seq > 1:
+            # shard i's final target is shard i+1's first token; the wrap
+            # pair (0 -> n-1) is masked below as the global final position
+            nxt = jax.lax.ppermute(
+                tokens[:, :1], seq_axis,
+                perm=[((i + 1) % n_seq, i) for i in range(n_seq)])
+            targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
+            is_last = jax.lax.axis_index(seq_axis) == n_seq - 1
+            mask = jnp.ones(targets.shape, jnp.float32)
+            mask = mask.at[:, -1].set(jnp.where(is_last, 0.0, 1.0))
+        else:
+            targets = tokens[:, 1:]
+            mask = jnp.ones(targets.shape, jnp.float32)
+
         def compute_loss(params):
             logits = model.apply({"params": params}, tokens)
-            return softmax_cross_entropy(logits[:, :-1], tokens[:, 1:])
+            if targets.shape[1] == logits.shape[1] - 1:
+                logits_t = logits[:, :-1]
+            else:
+                logits_t = logits
+            logp = jax.nn.log_softmax(logits_t.astype(jnp.float32), axis=-1)
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            local_sum = -jnp.sum(ll * mask)
+            global_count = collective.allreduce(
+                jnp.asarray(jnp.sum(mask), jnp.float32), op=collective.Sum,
+                axes=grad_axes)
+            # scaled so that the Average-allreduce of per-shard losses (and
+            # of per-shard gradients, inside ``tx``) equals the exact
+            # global-mean loss/gradient
+            return local_sum * n_shards / global_count
 
         loss, grads = jax.value_and_grad(compute_loss)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)

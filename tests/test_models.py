@@ -150,6 +150,58 @@ def test_ulysses_rejects_bad_heads(hvd, n_devices):
                        heads=n_devices + 1)
 
 
+def test_lm_loss_exact_under_seq_parallel(hvd, n_devices):
+    """Seq-parallel next-token loss/grads equal the single-device values.
+
+    Uses a positionwise LM (logits depend only on the local token) so the
+    only cross-shard coupling is the loss stitching itself: shard i's final
+    target must be shard i+1's first token, and normalization must be by
+    the global target count (VERDICT r1 item 8)."""
+    import flax.linen as nn
+
+    ndata = 2
+    nseq = n_devices // ndata
+    if nseq < 2:
+        pytest.skip("needs >=4 devices")
+
+    class PositionwiseLM(nn.Module):
+        vocab: int
+
+        @nn.compact
+        def __call__(self, tokens, train=False):
+            emb = self.param("emb", nn.initializers.normal(1.0),
+                             (self.vocab, self.vocab))
+            return emb[tokens]
+
+    model = PositionwiseLM(vocab=16)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, 16, size=(ndata * 2, nseq * 4)),
+        jnp.int32)
+
+    def run(mesh, axes, batch_axis, seq_axis):
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.1), axes=axes)
+        state = training.create_train_state(
+            model, tx, jax.random.PRNGKey(7), tokens[:1])
+        step = training.make_lm_train_step(
+            model, tx, mesh=mesh, batch_axis=batch_axis, seq_axis=seq_axis,
+            donate=False)
+        state, loss = step(state, tokens)
+        return float(loss), state.params
+
+    mesh1 = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    loss_ref, params_ref = run(mesh1, ("data",), "data", None)
+
+    devs = np.asarray(jax.devices()).reshape(ndata, nseq)
+    mesh2 = jax.sharding.Mesh(devs, ("data", "seq"))
+    loss_par, params_par = run(mesh2, ("data", "seq"), "data", "seq")
+
+    np.testing.assert_allclose(loss_par, loss_ref, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6),
+        params_par, params_ref)
+
+
 def test_lm_train_step_sequence_parallel(hvd, n_devices):
     """Transformer with ring attention over a (data, seq) mesh trains."""
     ndata = 2
