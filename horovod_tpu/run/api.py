@@ -11,6 +11,7 @@ import pickle
 import sys
 
 from horovod_tpu.run import allocation, launcher
+from horovod_tpu.run import secret as _secret
 from horovod_tpu.run.rendezvous import KVStoreServer, kv_wait
 
 try:  # cloudpickle handles closures/lambdas; stdlib pickle is the fallback
@@ -34,11 +35,17 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
     controller_port = 0  # rank 0 binds + publishes via the KV server
 
     all_local = all(s.hostname in launcher.LOCAL_HOSTS for s in slots)
-    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0")
+    # multi-host: per-run HMAC key so no unauthenticated peer can feed
+    # pickles into the KV (reference secret.py contract)
+    auth_key = None if all_local else _secret.make_secret_key()
+    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0",
+                       auth_key=auth_key)
     rendezvous_port = kv.start()
     kv.put("runfunc/func", _pickler.dumps((fn, args, kwargs)))
 
     env = dict(extra_env or {})
+    if auth_key is not None:
+        env[_secret.SECRET_ENV] = _secret.encode_key(auth_key)
     repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                              os.pardir, os.pardir))
     existing = [p for p in
@@ -69,7 +76,8 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
         results = []
         for r in range(np):
             payload = kv_wait("127.0.0.1", rendezvous_port,
-                              f"runfunc/result/{r}", timeout=timeout)
+                              f"runfunc/result/{r}", timeout=timeout,
+                              auth_key=auth_key)
             ok, value = pickle.loads(payload)
             if not ok:
                 raise RuntimeError(f"rank {r} raised:\n{value}")

@@ -13,6 +13,8 @@ import subprocess
 import sys
 import threading
 
+from horovod_tpu.run.secret import SECRET_ENV
+
 LOCAL_HOSTS = ("localhost", "127.0.0.1")
 
 
@@ -39,20 +41,53 @@ def slot_env(slot, controller_addr, controller_port, rendezvous_addr=None,
     return env
 
 
-def build_command(slot, command, env, ssh_port=None, cwd=None):
-    """Local slots exec the command directly; remote slots wrap it in ssh
-    with inline env exports (reference gloo_run.py:262-288)."""
-    if slot.hostname in LOCAL_HOSTS:
-        return command, env  # merged with os.environ by the spawner
+def build_command(hostname, command, env, ssh_port=None, cwd=None):
+    """Local hosts exec the command directly; remote hosts wrap it in ssh
+    with inline env exports (reference gloo_run.py:262-288).
+
+    Returns ``(cmd, proc_env, stdin_payload)``. The per-run HMAC secret
+    must never ride the ssh argv (world-readable in /proc/*/cmdline on
+    every host), so for remote hosts it is stripped from the inline
+    exports and shipped over the ssh channel's stdin instead; the remote
+    end reads one line into the env before exec. The remote string runs
+    under an explicit ``/bin/sh -c`` so a csh/fish login shell can't
+    break the POSIX prefix."""
+    if hostname in LOCAL_HOSTS:
+        # local: plain process env — readable only by the same user
+        return command, env, None
+    env = dict(env)
+    payload = None
+    prefix = ""
+    if SECRET_ENV in env:
+        payload = (env.pop(SECRET_ENV) + "\n").encode()
+        prefix = f"IFS= read -r {SECRET_ENV}; export {SECRET_ENV}; "
     exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
     remote_cwd = cwd or os.getcwd()
-    remote = (f"cd {shlex.quote(remote_cwd)} && env {exports} " +
+    remote = (prefix + f"cd {shlex.quote(remote_cwd)} && env {exports} " +
               " ".join(shlex.quote(c) for c in command))
     ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if ssh_port:
         ssh += ["-p", str(ssh_port)]
-    ssh += [slot.hostname, remote]
-    return ssh, {}
+    ssh += [hostname, f"exec /bin/sh -c {shlex.quote(remote)}"]
+    return ssh, {}, payload
+
+
+def spawn(hostname, command, env, ssh_port=None, stdout=None):
+    """Build + Popen one host process, handling the env merge and the
+    secret-over-stdin contract in one place (used by the training launch
+    and the discovery pre-flight)."""
+    cmd, proc_env, payload = build_command(hostname, command, env,
+                                           ssh_port=ssh_port)
+    full_env = dict(os.environ)
+    full_env.update(proc_env if cmd[0] == "ssh" else env)
+    proc = subprocess.Popen(
+        cmd, env=full_env, stdout=stdout,
+        stderr=subprocess.STDOUT if stdout else None,
+        stdin=subprocess.PIPE if payload else None)
+    if payload:
+        proc.stdin.write(payload)
+        proc.stdin.close()
+    return proc
 
 
 class Job:
@@ -103,14 +138,19 @@ class Job:
                 f"remaining processes were terminated")
 
 
+def this_host_addr():
+    """This machine's address as remote workers should dial it."""
+    import socket
+    return socket.gethostbyname(socket.gethostname())
+
+
 def launcher_addr(slots):
     """Address where workers can reach services running on the LAUNCHER
     machine (the KV/rendezvous server): loopback for all-local jobs, this
     host's address otherwise."""
-    import socket
     if all(s.hostname in LOCAL_HOSTS for s in slots):
         return "127.0.0.1"
-    return socket.gethostbyname(socket.gethostname())
+    return this_host_addr()
 
 
 def launch(slots, command, controller_addr, controller_port,
@@ -124,17 +164,13 @@ def launch(slots, command, controller_addr, controller_port,
         env = slot_env(slot, controller_addr, controller_port,
                        rendezvous_addr=rendezvous_addr,
                        rendezvous_port=rendezvous_port, extra_env=extra_env)
-        cmd, proc_env = build_command(slot, command, env, ssh_port=ssh_port)
-        full_env = dict(os.environ)
-        full_env.update(proc_env if cmd[0] == "ssh" else env)
         out = stdout
         if output_dir:
             os.makedirs(output_dir, exist_ok=True)
             out = open(os.path.join(output_dir, f"rank.{slot.rank}.log"),
                        "wb")
-        job.procs.append(subprocess.Popen(
-            cmd, env=full_env, stdout=out,
-            stderr=subprocess.STDOUT if out else None))
+        job.procs.append(spawn(slot.hostname, command, env,
+                               ssh_port=ssh_port, stdout=out))
     # fan out SIGINT/SIGTERM (only from the main thread of the CLI)
     if threading.current_thread() is threading.main_thread():
         def _forward(signum, frame):

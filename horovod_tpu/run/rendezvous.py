@@ -6,15 +6,26 @@ scoped by path (``/scope/key``). Used by the launcher to pass pickled
 functions and collect results (``horovod.run.run()`` pattern) and
 available to external tooling as a rendezvous point. GET on a missing key
 returns 404 so clients can poll (reference http_server.py:40-60).
+
+When constructed with ``auth_key``, every request must carry a valid
+``X-HVD-Auth`` HMAC header (see run/secret.py) or it is rejected with
+403 — the HTTP realization of the reference's HMAC-signed service RPC
+(``run/common/util/network.py:61-86`` Wire, ``secret.py``). The store
+carries pickled functions, so multi-host runs must always authenticate.
 """
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from horovod_tpu.run import secret as _secret
+
+AUTH_HEADER = "X-HVD-Auth"
+
 
 class _Handler(BaseHTTPRequestHandler):
     store = None  # class attribute set by the server
     lock = None
+    auth_key = None
 
     def log_message(self, *args):  # quiet
         pass
@@ -22,7 +33,19 @@ class _Handler(BaseHTTPRequestHandler):
     def _key(self):
         return self.path.lstrip("/")
 
+    def _authorized(self, body=b""):
+        if self.auth_key is None:
+            return True
+        return _secret.verify(self.auth_key, self.command, self.path, body,
+                              self.headers.get(AUTH_HEADER))
+
+    def _reject(self):
+        self.send_response(403)
+        self.end_headers()
+
     def do_GET(self):
+        if not self._authorized():
+            return self._reject()
         with self.lock:
             val = self.store.get(self._key())
         if val is None:
@@ -34,15 +57,33 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(val)
 
+    # Body cap: legitimate payloads (pickled fns, addresses, results) stay
+    # far below this. The signature covers the body, so verification
+    # can't precede the read — the cap plus the header-shape precheck
+    # bound what a garbage request can make us buffer; they don't defend
+    # against a determined flood (that needs a firewall, not a KV).
+    MAX_BODY = 64 << 20
+
+    def _header_plausible(self):
+        sig = self.headers.get(AUTH_HEADER, "")
+        return len(sig) == 64 and all(c in "0123456789abcdef" for c in sig)
+
     def do_PUT(self):
         length = int(self.headers.get("Content-Length", 0))
+        if length > self.MAX_BODY or (
+                self.auth_key is not None and not self._header_plausible()):
+            return self._reject()
         body = self.rfile.read(length)
+        if not self._authorized(body):
+            return self._reject()
         with self.lock:
             self.store[self._key()] = body
         self.send_response(200)
         self.end_headers()
 
     def do_DELETE(self):
+        if not self._authorized():
+            return self._reject()
         with self.lock:
             self.store.pop(self._key(), None)
         self.send_response(200)
@@ -56,9 +97,10 @@ class KVStoreServer:
     must not be reachable from the network unless the job actually spans
     hosts (pass ``host="0.0.0.0"`` then)."""
 
-    def __init__(self, port=0, host="127.0.0.1"):
+    def __init__(self, port=0, host="127.0.0.1", auth_key=None):
         handler = type("Handler", (_Handler,),
-                       {"store": {}, "lock": threading.Lock()})
+                       {"store": {}, "lock": threading.Lock(),
+                        "auth_key": auth_key})
         self._handler_cls = handler
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread = None
@@ -89,12 +131,22 @@ class KVStoreServer:
             self._handler_cls.store[key] = value
 
 
-def kv_get(addr, port, key, timeout=5.0):
+def _headers(auth_key, method, key, body=b""):
+    if auth_key is None:
+        return {}
+    return {AUTH_HEADER: _secret.sign(auth_key, method, "/" + key, body)}
+
+
+def kv_get(addr, port, key, timeout=5.0, auth_key=None):
     import urllib.error
     import urllib.request
+    if auth_key is None:
+        auth_key = _secret.key_from_env()
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{key}",
+        headers=_headers(auth_key, "GET", key))
     try:
-        with urllib.request.urlopen(
-                f"http://{addr}:{port}/{key}", timeout=timeout) as r:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.read()
     except urllib.error.HTTPError as e:
         if e.code == 404:
@@ -102,18 +154,21 @@ def kv_get(addr, port, key, timeout=5.0):
         raise
 
 
-def kv_put(addr, port, key, value):
+def kv_put(addr, port, key, value, auth_key=None):
     import urllib.request
-    req = urllib.request.Request(f"http://{addr}:{port}/{key}",
-                                 data=value, method="PUT")
+    if auth_key is None:
+        auth_key = _secret.key_from_env()
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{key}", data=value, method="PUT",
+        headers=_headers(auth_key, "PUT", key, value))
     urllib.request.urlopen(req, timeout=5.0).read()
 
 
-def kv_wait(addr, port, key, timeout=60.0, poll=0.1):
+def kv_wait(addr, port, key, timeout=60.0, poll=0.1, auth_key=None):
     import time
     deadline = time.time() + timeout
     while time.time() < deadline:
-        v = kv_get(addr, port, key)
+        v = kv_get(addr, port, key, auth_key=auth_key)
         if v is not None:
             return v
         time.sleep(poll)

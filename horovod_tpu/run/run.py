@@ -11,6 +11,8 @@ import socket
 import sys
 
 from horovod_tpu.run import allocation, config_parser, launcher
+from horovod_tpu.run import secret as _secret
+from horovod_tpu.run.discovery import DriverService
 from horovod_tpu.run.rendezvous import KVStoreServer
 
 
@@ -35,6 +37,11 @@ def build_parser():
     p.add_argument("--jax-coordinator", action="store_true",
                    help="also start a jax.distributed coordinator so the "
                         "workers form one global TPU mesh")
+    p.add_argument("--network-interface", "--nic", dest="nic", default=None,
+                   help="restrict control-plane traffic to this interface "
+                        "(skips automatic interface discovery)")
+    p.add_argument("--no-interface-discovery", action="store_true",
+                   help="skip the multi-host NIC discovery pre-flight")
 
     tune = p.add_argument_group("tuning (sets HOROVOD_* env)")
     tune.add_argument("--fusion-threshold-mb", type=int, default=None)
@@ -83,6 +90,50 @@ def free_port():
     return port
 
 
+def _discover_interfaces(hosts, auth_key, kv_port, args, extra_env):
+    """Multi-host pre-flight (reference gloo_run driver/task services):
+    run one task_fn per host, ring-probe, and return the interface names
+    routable between every pair of adjacent hosts."""
+    launcher_ip = launcher.this_host_addr()
+    env = {_secret.SECRET_ENV: _secret.encode_key(auth_key),
+           "PYTHONPATH": extra_env.get("PYTHONPATH",
+                                       os.environ.get("PYTHONPATH", ""))}
+    procs = []
+    for idx, h in enumerate(hosts):
+        cmd = [sys.executable, "-m", "horovod_tpu.run.task_fn",
+               str(idx), str(len(hosts)), launcher_ip, str(kv_port),
+               str(args.start_timeout)]
+        procs.append(launcher.spawn(h.hostname, cmd, env,
+                                    ssh_port=args.ssh_port))
+
+    def _alive():  # a non-zero exit means ssh/startup failure
+        return not any(p.poll() not in (None, 0) for p in procs)
+
+    driver = DriverService(len(hosts), launcher_ip, kv_port, auth_key,
+                           liveness=_alive)
+    try:
+        driver.wait_for_registrations(timeout=args.start_timeout)
+        common = driver.wait_for_probes(timeout=args.start_timeout)
+        if not common:
+            raise RuntimeError(
+                "interface discovery found NO interface routable across "
+                "all hosts (interfaces must share a name on every host; "
+                "NAT'ed paths are rejected)")
+    except (TimeoutError, RuntimeError) as e:
+        for p in procs:
+            p.kill()
+        raise RuntimeError(
+            f"hvdrun: interface discovery failed: {e}\n"
+            f"Check ssh connectivity and interface naming, or pass "
+            f"--network-interface / --no-interface-discovery") from e
+    for p in procs:
+        p.wait()
+    if args.verbose:
+        print(f"hvdrun: common routable interfaces: {common}",
+              file=sys.stderr)
+    return common
+
+
 def _run(args):
     if not args.command:
         raise SystemExit("hvdrun: no training command given")
@@ -103,11 +154,24 @@ def _run(args):
         controller_addr = "127.0.0.1"
     controller_port = 0
 
+    # multi-host runs get a per-run HMAC key; the KV then rejects any
+    # unauthenticated request (reference secret.py + network.py Wire)
     all_local = all(s.hostname in launcher.LOCAL_HOSTS for s in slots)
-    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0")
+    auth_key = None if all_local else _secret.make_secret_key()
+    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0",
+                       auth_key=auth_key)
     rendezvous_port = kv.start()
 
     extra_env = config_parser.args_to_env(args)
+    if auth_key is not None:
+        extra_env[_secret.SECRET_ENV] = _secret.encode_key(auth_key)
+    if args.nic:
+        extra_env["HOROVOD_COMMON_INTERFACES"] = args.nic
+    elif not all_local and not args.no_interface_discovery:
+        common = _discover_interfaces(hosts, auth_key, rendezvous_port,
+                                      args, extra_env)
+        if common:
+            extra_env["HOROVOD_COMMON_INTERFACES"] = ",".join(common)
     if args.jax_coordinator:
         # probing is only sound for a local rank 0; remote gets a random
         # high port (collision unlikely, bind failure is loud)

@@ -55,6 +55,19 @@ def start(state):
         advertise = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
         if advertise in ("localhost",):
             advertise = "127.0.0.1"
+        # hvdrun's NIC-discovery pre-flight (run/discovery.py) elects the
+        # interfaces routable across all hosts; advertise this host's
+        # address on the first elected interface we own, so the peer mesh
+        # never hands out a NAT'ed/bridge address (reference: gloo
+        # iface selection from the driver/task services)
+        common = os.environ.get("HOROVOD_COMMON_INTERFACES")
+        if common and advertise != "127.0.0.1":
+            from horovod_tpu.run.discovery import local_interfaces
+            mine = local_interfaces()
+            for intf in common.split(","):
+                if mine.get(intf):
+                    advertise = mine[intf][0][0]
+                    break
         controller_port = cfg.controller_port
         if controller_port == 0:
             controller_port = _resolve_controller_port(cfg)

@@ -102,8 +102,10 @@ def test_slot_env_contract():
 def test_build_command_ssh():
     slot = allocation.Slot(rank=2, hostname="remotehost", local_rank=0,
                            local_size=2, cross_rank=1, cross_size=2, size=4)
-    cmd, env = launcher.build_command(
-        slot, ["python", "train.py"], {"HOROVOD_RANK": "2"}, ssh_port=2222)
+    cmd, env, payload = launcher.build_command(
+        slot.hostname, ["python", "train.py"], {"HOROVOD_RANK": "2"},
+        ssh_port=2222)
+    assert payload is None
     assert cmd[0] == "ssh"
     assert "-p" in cmd and "2222" in cmd
     assert cmd[-2] == "remotehost"
@@ -114,8 +116,9 @@ def test_build_command_ssh():
 def test_build_command_local():
     slot = allocation.Slot(rank=0, hostname="localhost", local_rank=0,
                            local_size=1, cross_rank=0, cross_size=1, size=1)
-    cmd, env = launcher.build_command(slot, ["python", "t.py"],
-                                      {"HOROVOD_RANK": "0"})
+    cmd, env, payload = launcher.build_command(
+        slot.hostname, ["python", "t.py"], {"HOROVOD_RANK": "0"})
+    assert payload is None
     assert cmd == ["python", "t.py"]
     assert env["HOROVOD_RANK"] == "0"
 
