@@ -63,6 +63,7 @@ from horovod_tpu.hvd_jax import (
     allreduce_metrics,
     join,
 )
+from horovod_tpu import checkpoint
 
 __version__ = "0.1.0"
 
@@ -78,4 +79,5 @@ __all__ = [
     "distributed_grad", "distributed_value_and_grad",
     "broadcast_variables", "broadcast_parameters",
     "broadcast_optimizer_state", "allreduce_metrics", "join",
+    "checkpoint",
 ]

@@ -46,11 +46,7 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
     env = dict(extra_env or {})
     if auth_key is not None:
         env[_secret.SECRET_ENV] = _secret.encode_key(auth_key)
-    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
-                                             os.pardir, os.pardir))
-    existing = [p for p in
-                os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
-    env["PYTHONPATH"] = os.pathsep.join([repo_root] + existing)
+    env["PYTHONPATH"] = launcher.repo_pythonpath()
     if use_jax_coordinator:
         from horovod_tpu.run.run import free_port
         env["HOROVOD_COORDINATOR_ADDR"] = (

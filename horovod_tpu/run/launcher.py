@@ -144,6 +144,16 @@ def this_host_addr():
     return socket.gethostbyname(socket.gethostname())
 
 
+def repo_pythonpath(base_env=None):
+    """PYTHONPATH that puts this checkout first, preserving whatever the
+    caller had (shared by the programmatic and cluster launch paths)."""
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir, os.pardir))
+    existing = [p for p in (base_env or os.environ).get(
+        "PYTHONPATH", "").split(os.pathsep) if p]
+    return os.pathsep.join([root] + existing)
+
+
 def launcher_addr(slots):
     """Address where workers can reach services running on the LAUNCHER
     machine (the KV/rendezvous server): loopback for all-local jobs, this

@@ -47,8 +47,10 @@ def sign(key, method, path, body=b""):
 
 
 def verify(key, method, path, body, digest_hex):
-    """Constant-time check (reference secret.py:35-36)."""
+    """Constant-time check (reference secret.py:35-36). Compares as
+    bytes: compare_digest on str raises for non-ASCII input, which a
+    hostile header could otherwise use to crash the handler thread."""
     if not digest_hex:
         return False
     expected = sign(key, method, path, body)
-    return hmac.compare_digest(expected, digest_hex)
+    return hmac.compare_digest(expected.encode(), digest_hex.encode())

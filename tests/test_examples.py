@@ -41,3 +41,17 @@ def test_synthetic_benchmark_tiny():
                           "--xla_force_host_platform_device_count=8",
                           "PALLAS_AXON_POOL_IPS": ""})
     assert "Img/sec per chip" in out
+
+
+def test_checkpoint_resume_example(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    # first leg: 4 epochs
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, "examples/jax_checkpoint_resume.py",
+                "--ckpt-dir", ckpt, "--epochs", "4"])
+    assert "epoch 4" in out
+    # second leg resumes at 4 and finishes
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, "examples/jax_checkpoint_resume.py",
+                "--ckpt-dir", ckpt, "--epochs", "8"])
+    assert "resuming from step 4" in out and "epoch 8" in out
