@@ -1,15 +1,28 @@
 """TensorFlow adapter (reference: ``horovod/tensorflow/__init__.py``).
 
-Eager-mode TF2 over the native core's host data plane, mirroring the torch
-adapter: tensors bridge through numpy into the name-negotiated queue
-(reference role: the ``HorovodAllreduceOp`` custom kernels,
-``tensorflow/mpi_ops.cc:287-460``). TensorFlow is not part of this image's
-baked environment, so the module import-gates: everything works when TF is
-installed, and a clear error points JAX-first users to the native path.
+Eager-mode TF2 + TF1-style optimizer wrapping over the native core's host
+data plane, mirroring the torch adapter: tensors bridge through numpy
+into the name-negotiated queue (reference role: the custom
+``HorovodAllreduceOp`` kernels, ``tensorflow/mpi_ops.cc:287-460``).
 
-``DistributedGradientTape`` wraps ``tf.GradientTape`` so ``gradient()``
-returns allreduced gradients (reference ``__init__.py:475-531``);
-``broadcast_variables`` syncs initial state (``__init__.py:139``).
+Covered contracts:
+
+* ``allreduce`` with the **IndexedSlices → two-allgathers** fallback
+  (reference ``__init__.py:43-118``: sparse gradients allgather values
+  and indices instead of reducing dense zeros),
+* fp16 wire compression on the dense path (reference Compression),
+* ``DistributedOptimizer`` overriding ``compute_gradients`` (reference
+  ``__init__.py:266-311``) with ``sparse_as_dense`` option,
+* ``DistributedGradientTape`` for TF2 eager (``__init__.py:475-531``),
+* ``broadcast_variables`` / ``broadcast_global_variables``
+  (``__init__.py:139-188``),
+* ``horovod_tpu.tensorflow.keras.load_model`` wrapping saved optimizers
+  in DistributedOptimizer (reference ``keras/__init__.py:117-150``).
+
+TensorFlow is not part of this image's baked environment, so the module
+import-gates; the adapter logic is exercised in-image against a
+numpy-backed stand-in (``tests/fake_tensorflow.py``) the same way the
+MXNet adapter is — the code paths are identical either way.
 """
 
 try:
@@ -30,10 +43,35 @@ from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size",
-    "Sum", "Average", "Adasum", "Min", "Max",
+    "Sum", "Average", "Adasum", "Min", "Max", "Compression",
     "allreduce", "allgather", "broadcast", "broadcast_variables",
-    "DistributedGradientTape",
+    "broadcast_global_variables", "DistributedGradientTape",
+    "DistributedOptimizer",
 ]
+
+
+class Compression:
+    """fp16 wire compression (reference ``tensorflow/compression.py``)."""
+
+    class none:
+        @staticmethod
+        def compress(arr):
+            return arr, arr.dtype
+
+        @staticmethod
+        def decompress(arr, dtype):
+            return arr
+
+    class fp16:
+        @staticmethod
+        def compress(arr):
+            if arr.dtype in (np.float32, np.float64):
+                return arr.astype(np.float16), arr.dtype
+            return arr, arr.dtype
+
+        @staticmethod
+        def decompress(arr, dtype):
+            return arr.astype(dtype) if arr.dtype != dtype else arr
 
 
 def _ensure_core():
@@ -56,25 +94,55 @@ def _auto_name(kind, name):
     return f"tf.{kind}.{n}"
 
 
-def allreduce(tensor, average=True, name=None, op=None):
+def _to_numpy(tensor):
+    if hasattr(tensor, "numpy"):
+        return np.asarray(tensor.numpy())
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              compression=Compression.none):
+    """Allreduce a tf.Tensor — or allgather an ``tf.IndexedSlices``
+    (sparse gradients reduce as gathered (values, indices) pairs, the
+    reference's bandwidth answer for embeddings,
+    ``tensorflow/__init__.py:74-89``)."""
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    if isinstance(tensor, tf.IndexedSlices):
+        if op == Adasum:
+            raise NotImplementedError(
+                "Adasum does not support sparse tensors; pass "
+                "sparse_as_dense=True to DistributedOptimizer")
+        # distinct wire names per component: one tensor name must map to
+        # one (shape, dtype) stream or the response cache re-negotiates
+        # every step (cxx/src/response_cache.cc:9-14)
+        values = allgather(tensor.values,
+                           name=None if name is None else name + ".values")
+        indices = allgather(tensor.indices,
+                            name=None if name is None else name + ".indices")
+        if op == Average:
+            values = values / float(size())
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
     core = _ensure_core()
-    op = op or (Average if average else Sum)
-    out = core.allreduce(np.asarray(tensor), _auto_name("allreduce", name),
-                         op=op)
-    return tf.convert_to_tensor(out)
+    arr = _to_numpy(tensor)
+    compressed, dtype = compression.compress(arr)
+    out = core.allreduce(compressed, _auto_name("allreduce", name), op=op)
+    return tf.convert_to_tensor(compression.decompress(np.asarray(out),
+                                                       dtype))
 
 
 def allgather(tensor, name=None):
     core = _ensure_core()
-    out = core.allgather(np.asarray(tensor), _auto_name("allgather", name))
-    return tf.convert_to_tensor(out)
+    out = core.allgather(_to_numpy(tensor), _auto_name("allgather", name))
+    return tf.convert_to_tensor(np.asarray(out))
 
 
 def broadcast(tensor, root_rank=0, name=None):
     core = _ensure_core()
-    out = core.broadcast(np.asarray(tensor), _auto_name("broadcast", name),
+    out = core.broadcast(_to_numpy(tensor), _auto_name("broadcast", name),
                          root_rank=root_rank)
-    return tf.convert_to_tensor(out)
+    return tf.convert_to_tensor(np.asarray(out))
 
 
 def broadcast_variables(variables, root_rank=0):
@@ -84,13 +152,110 @@ def broadcast_variables(variables, root_rank=0):
         v.assign(broadcast(v.value(), root_rank, name=f"bv.{i}"))
 
 
-class DistributedGradientTape:
-    """``tf.GradientTape`` wrapper whose ``gradient()`` allreduces
-    (reference ``tensorflow/__init__.py:475-531``)."""
+def broadcast_global_variables(root_rank=0):
+    """TF1-compat alias over every trainable variable TF tracks
+    (reference ``tensorflow/__init__.py:157-170``); in TF2 eager there
+    is no global collection, so this requires an explicit registry."""
+    coll = getattr(tf.compat.v1, "global_variables", None) \
+        if hasattr(tf, "compat") else None
+    variables = coll() if coll is not None else []
+    if not variables:
+        # TF2 eager populates no global collections — a silent no-op here
+        # would leave ranks unsynchronized, which is worse than an error
+        raise NotImplementedError(
+            "broadcast_global_variables needs TF1 global collections "
+            "(none found); in TF2 call "
+            "broadcast_variables(model.variables) instead")
+    broadcast_variables(variables, root_rank)
 
-    def __init__(self, tape, op=Average):
+
+def _sparse_to_dense(tensor):
+    if not isinstance(tensor, tf.IndexedSlices):
+        return tensor
+    values = _to_numpy(tensor.values)
+    indices = _to_numpy(tensor.indices).astype(np.int64)
+    shape = tensor.dense_shape
+    if shape is None:
+        raise ValueError("sparse_as_dense needs a dense_shape")
+    dense = np.zeros(tuple(int(d) for d in _to_numpy(shape)),
+                     dtype=values.dtype)
+    np.add.at(dense, indices, values)
+    return tf.convert_to_tensor(dense)
+
+
+def _allreduce_grads(grads, op, compression, sparse_as_dense, prefix):
+    out = []
+    for i, g in enumerate(grads):
+        if g is None:
+            out.append(None)
+            continue
+        if sparse_as_dense:
+            g = _sparse_to_dense(g)
+        out.append(allreduce(g, op=op, name=f"{prefix}.{i}",
+                             compression=compression))
+    return out
+
+
+class DistributedOptimizer:
+    """TF1-style optimizer wrapper: ``compute_gradients`` allreduces
+    before returning (reference ``_DistributedOptimizer``,
+    ``tensorflow/__init__.py:266-311``); everything else delegates."""
+
+    def __init__(self, optimizer, name=None, op=Average,
+                 compression=Compression.none, sparse_as_dense=False):
+        self._optimizer = optimizer
+        self._name = name or f"Distributed{type(optimizer).__name__}"
+        self._op = op
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+
+    def compute_gradients(self, *args, **kwargs):
+        gradients = self._optimizer.compute_gradients(*args, **kwargs)
+        if size() <= 1 or not gradients:
+            return gradients
+        grads, variables = zip(*gradients)
+        avg = _allreduce_grads(grads, self._op, self._compression,
+                               self._sparse_as_dense, self._name)
+        return list(zip(avg, variables))
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._optimizer.apply_gradients(*args, **kwargs)
+
+    def minimize(self, loss, global_step=None, var_list=None, **kwargs):
+        """TF1 minimize contract: split the arguments between
+        compute_gradients and apply_gradients (global_step belongs to
+        the latter)."""
+        grads_and_vars = self.compute_gradients(loss, var_list=var_list,
+                                                **kwargs)
+        if global_step is None:
+            return self.apply_gradients(grads_and_vars)
+        return self.apply_gradients(grads_and_vars,
+                                    global_step=global_step)
+
+    def get_slot(self, *args, **kwargs):
+        return self._optimizer.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._optimizer.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._optimizer.variables(*args, **kwargs)
+
+    def get_config(self):
+        return self._optimizer.get_config()
+
+
+class DistributedGradientTape:
+    """``tf.GradientTape`` wrapper whose ``gradient()`` allreduces,
+    with the same sparse handling as DistributedOptimizer (reference
+    ``tensorflow/__init__.py:475-531``)."""
+
+    def __init__(self, tape, op=Average, compression=Compression.none,
+                 sparse_as_dense=False):
         self._tape = tape
         self._op = op
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
 
     def __enter__(self):
         self._tape.__enter__()
@@ -104,6 +269,7 @@ class DistributedGradientTape:
 
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
-        return [None if g is None else
-                allreduce(g, op=self._op, name=f"tape.{i}")
-                for i, g in enumerate(grads)]
+        if size() <= 1:
+            return grads
+        return _allreduce_grads(grads, self._op, self._compression,
+                                self._sparse_as_dense, "tape")

@@ -1,0 +1,198 @@
+"""Minimal numpy-backed stand-in for tensorflow, enough to exercise the
+horovod_tpu.tensorflow adapter logic in-image (TF is not baked into the
+environment). Mirrors the slivers of API the adapter touches:
+``convert_to_tensor``/Tensor with ``.numpy()``, ``IndexedSlices``,
+``Variable`` with ``assign``/``value``, a preset-gradient
+``GradientTape``, a TF1-style optimizer, and keras
+``optimizers.SGD`` + pickle-backed ``models.save_model/load_model``
+with ``custom_objects`` resolution (what hvd's load_model hooks into).
+"""
+
+import pickle
+import sys
+import types
+
+import numpy as np
+
+
+class Tensor:
+    def __init__(self, data):
+        self._data = np.asarray(data)
+
+    def numpy(self):
+        return self._data.copy()
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+    def __truediv__(self, other):
+        return Tensor(self._data / other)
+
+    def __array__(self, dtype=None):
+        return self._data if dtype is None else self._data.astype(dtype)
+
+
+def convert_to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, Variable):
+        return Tensor(x.numpy())
+    return Tensor(np.asarray(x))
+
+
+class IndexedSlices:
+    """Sparse gradient triple (reference tf.IndexedSlices)."""
+
+    def __init__(self, values, indices, dense_shape=None):
+        self.values = (values if isinstance(values, Tensor)
+                       else Tensor(values))
+        self.indices = (indices if isinstance(indices, Tensor)
+                        else Tensor(indices))
+        self.dense_shape = dense_shape
+
+
+class Variable:
+    def __init__(self, data):
+        self._data = np.array(data, copy=True)
+
+    def numpy(self):
+        return self._data.copy()
+
+    def value(self):
+        return Tensor(self._data)
+
+    def assign(self, value):
+        self._data = np.array(np.asarray(value), copy=True)
+        return self
+
+
+class GradientTape:
+    """Preset-gradient tape: real autodiff is TF's business, the adapter
+    only post-processes what gradient() returns."""
+
+    def __init__(self, grads=None):
+        self._grads = grads or []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def watch(self, t):
+        pass
+
+    def gradient(self, target, sources, output_gradients=None):
+        return list(self._grads)
+
+
+class _V1Optimizer:
+    """TF1-style optimizer: compute_gradients/apply_gradients. Gradients
+    are preset by tests (``_test_grads``)."""
+
+    def __init__(self, lr=0.1):
+        self.lr = lr
+        self._test_grads = []
+
+    def compute_gradients(self, loss=None, var_list=None):
+        return list(zip(self._test_grads, var_list))
+
+    def apply_gradients(self, grads_and_vars, global_step=None,
+                        name=None):
+        if global_step is not None:
+            global_step.assign(np.asarray(global_step.numpy()) + 1)
+        for g, v in grads_and_vars:
+            if g is None:
+                continue
+            v.assign(v.numpy() - self.lr * np.asarray(g))
+
+    def get_slot(self, *a, **k):
+        return None
+
+    def get_slot_names(self):
+        return []
+
+    def variables(self):
+        return []
+
+    def get_config(self):
+        return {"lr": self.lr}
+
+
+class SGD:
+    def __init__(self, lr=0.1):
+        self.lr = lr
+        self._test_grads = []
+
+    def get_config(self):
+        return {"lr": self.lr}
+
+    def get_gradients(self, loss, params):
+        return list(self._test_grads)
+
+    @classmethod
+    def from_config(cls, config):
+        return cls(**config)
+
+
+class _KerasModel:
+    def __init__(self, weights, optimizer):
+        self.weights = dict(weights)
+        self.optimizer = optimizer
+
+
+def _save_model(model, filepath):
+    blob = {"weights": {k: np.asarray(v) for k, v in
+                        model.weights.items()},
+            "optimizer_class": type(model.optimizer).__name__
+            if not hasattr(type(model.optimizer), "_hvd_wrapped")
+            else type(model.optimizer)._hvd_wrapped.__name__,
+            "optimizer_config": model.optimizer.get_config()}
+    with open(filepath, "wb") as f:
+        pickle.dump(blob, f)
+
+
+def _load_model(filepath, custom_objects=None):
+    with open(filepath, "rb") as f:
+        blob = pickle.load(f)
+    name = blob["optimizer_class"]
+    factory = (custom_objects or {}).get(name)
+    if factory is None:
+        factory = _REGISTRY[name]
+    opt = factory(**blob["optimizer_config"])
+    return _KerasModel(blob["weights"], opt)
+
+
+_REGISTRY = {"SGD": SGD}
+
+
+def install():
+    """Install the fake as ``sys.modules['tensorflow']`` (idempotent)."""
+    if "tensorflow" in sys.modules:
+        return sys.modules["tensorflow"]
+    tf = types.ModuleType("tensorflow")
+    tf.Tensor = Tensor
+    tf.convert_to_tensor = convert_to_tensor
+    tf.IndexedSlices = IndexedSlices
+    tf.Variable = Variable
+    tf.GradientTape = GradientTape
+    tf.train = types.ModuleType("tensorflow.train")
+    tf.train.Optimizer = _V1Optimizer
+    tf.keras = types.ModuleType("tensorflow.keras")
+    tf.keras.optimizers = types.ModuleType("tensorflow.keras.optimizers")
+    tf.keras.optimizers.SGD = SGD
+    tf.keras.models = types.ModuleType("tensorflow.keras.models")
+    tf.keras.models.save_model = _save_model
+    tf.keras.models.load_model = _load_model
+    tf.keras.Model = _KerasModel
+    sys.modules["tensorflow"] = tf
+    sys.modules["tensorflow.train"] = tf.train
+    sys.modules["tensorflow.keras"] = tf.keras
+    sys.modules["tensorflow.keras.optimizers"] = tf.keras.optimizers
+    sys.modules["tensorflow.keras.models"] = tf.keras.models
+    return tf
