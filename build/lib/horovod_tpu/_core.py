@@ -1,0 +1,285 @@
+"""ctypes binding to the native host core (libhvdcore.so).
+
+Rebuilds the reference's ctypes surface (``horovod/common/basics.py:22``
+loading the built extension and calling ``horovod_init``/...;
+``horovod/torch/mpi_ops.py`` handle-based async ops) against the
+TPU-framework core in ``cxx/``: name-negotiated queue, TCP controller,
+ring collectives, Adasum, timeline, stall inspector.
+
+The native core is the **host** data plane (numpy/torch CPU tensors, Join,
+barrier, parameter sync). TPU-resident arrays use the compiled XLA path in
+``horovod_tpu.ops.collective`` and never touch this module.
+"""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+# Request::Type (cxx/include/hvd/message.h)
+ALLREDUCE, ALLGATHER, BROADCAST, JOIN, ADASUM, ALLTOALL = 0, 1, 2, 3, 4, 5
+REDUCESCATTER, BARRIER = 6, 7
+# ReduceOp (cxx/include/hvd/cpu_ops.h)
+OP_SUM, OP_AVERAGE, OP_MIN, OP_MAX, OP_ADASUM = 0, 1, 2, 3, 4
+
+_DTYPE_MAP = {
+    np.dtype(np.uint8): 0, np.dtype(np.int8): 1,
+    np.dtype(np.uint16): 2, np.dtype(np.int16): 3,
+    np.dtype(np.int32): 4, np.dtype(np.int64): 5,
+    np.dtype(np.float16): 6, np.dtype(np.float32): 7,
+    np.dtype(np.float64): 8, np.dtype(np.bool_): 9,
+}
+
+_OP_MAP = {"sum": OP_SUM, "average": OP_AVERAGE, "min": OP_MIN,
+           "max": OP_MAX, "adasum": OP_ADASUM}
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libhvdcore.so")
+_CXX_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "cxx")
+
+_lib = None
+
+
+def build(force=False):
+    """Build libhvdcore.so from cxx/ (the reference's setup.py build step,
+    here a plain make). File-locked: concurrently launched ranks must not
+    run make into the same build dir at once."""
+    if os.path.exists(_LIB_PATH) and not force:
+        return _LIB_PATH
+    import fcntl
+    lock_path = os.path.join(os.path.dirname(__file__), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(_LIB_PATH) and not force:  # built while waiting
+                return _LIB_PATH
+            subprocess.run(["make", "-C", os.path.abspath(_CXX_DIR), "-j"],
+                           check=True, capture_output=True)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return _LIB_PATH
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.hvdc_init.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_int, ctypes.c_char_p]
+    lib.hvdc_enqueue.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double]
+    lib.hvdc_error_message.restype = ctypes.c_char_p
+    lib.hvdc_last_error.restype = ctypes.c_char_p
+    lib.hvdc_output_size.restype = ctypes.c_int64
+    lib.hvdc_copy_output.argtypes = [ctypes.c_int, ctypes.c_void_p]
+    lib.hvdc_autotune_state.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.hvdc_control_bytes.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    _lib = lib
+    return lib
+
+
+def core_available():
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def init(rank=0, size=1, coord_host="127.0.0.1", coord_port=0,
+         advertise_host="127.0.0.1"):
+    """Start the native core (background negotiation loop + TCP planes).
+    Reference: InitializeHorovodOnce (operations.cc:584)."""
+    lib = _load()
+    rv = lib.hvdc_init(rank, size, coord_host.encode(), coord_port,
+                       advertise_host.encode())
+    if rv != 0:
+        raise RuntimeError("native core init failed: " +
+                           lib.hvdc_last_error().decode())
+
+
+def shutdown():
+    if _lib is not None and _lib.hvdc_is_initialized():
+        _lib.hvdc_shutdown()
+
+
+def is_initialized():
+    return _lib is not None and bool(_lib.hvdc_is_initialized())
+
+
+def rank():
+    return _lib.hvdc_rank() if _lib is not None else -1
+
+
+def size():
+    return _lib.hvdc_size() if _lib is not None else -1
+
+
+class Handle:
+    """Async op handle (reference: horovod/torch/handle_manager.h)."""
+
+    def __init__(self, h, out_dtype, out_shape_hint=None):
+        self._h = h
+        self._dtype = out_dtype
+        self._shape_hint = out_shape_hint
+        self._released = False
+
+    def poll(self):
+        """True when the op has completed (reference hvd.poll)."""
+        return _lib.hvdc_poll(self._h) != 0
+
+    def wait(self):
+        """Block until done, return the result array (reference
+        hvd.synchronize)."""
+        if self._released:
+            raise RuntimeError("handle already synchronized")
+        rv = _lib.hvdc_wait(self._h)
+        if rv != 1:
+            msg = _lib.hvdc_error_message(self._h).decode()
+            _lib.hvdc_release(self._h)
+            self._released = True
+            raise RuntimeError(msg)
+        nbytes = _lib.hvdc_output_size(self._h)
+        out = np.empty(nbytes, dtype=np.uint8)
+        _lib.hvdc_copy_output(self._h,
+                              out.ctypes.data_as(ctypes.c_void_p))
+        _lib.hvdc_release(self._h)
+        self._released = True
+        arr = out.view(self._dtype)
+        if self._shape_hint is not None:
+            arr = arr.reshape(self._shape_hint)
+        return arr
+
+
+def _enqueue(req_type, name, array, op=OP_SUM, root_rank=-1, prescale=1.0,
+             postscale=1.0, out_shape=None):
+    lib = _load()
+    arr = np.ascontiguousarray(array)
+    if arr.dtype not in _DTYPE_MAP:
+        raise ValueError(f"unsupported dtype {arr.dtype}")
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    h = lib.hvdc_enqueue(req_type, name.encode(),
+                         arr.ctypes.data_as(ctypes.c_void_p), shape,
+                         arr.ndim, _DTYPE_MAP[arr.dtype], op, root_rank,
+                         prescale, postscale)
+    if h < 0:
+        raise RuntimeError(lib.hvdc_last_error().decode())
+    return Handle(h, arr.dtype, out_shape)
+
+
+def allreduce_async(array, name, op="average", prescale=1.0, postscale=1.0):
+    arr = np.ascontiguousarray(array)
+    req = ADASUM if op == "adasum" else ALLREDUCE
+    return _enqueue(req, name, arr, _OP_MAP[op], out_shape=arr.shape,
+                    prescale=prescale, postscale=postscale)
+
+
+def allreduce(array, name, op="average", **kw):
+    return allreduce_async(array, name, op, **kw).wait()
+
+
+def allgather_async(array, name):
+    arr = np.ascontiguousarray(array)
+    out_shape = (-1,) + arr.shape[1:] if arr.ndim > 0 else (-1,)
+    return _enqueue(ALLGATHER, name, arr, out_shape=out_shape)
+
+
+def allgather(array, name):
+    return allgather_async(array, name).wait()
+
+
+def broadcast_async(array, name, root_rank=0):
+    arr = np.ascontiguousarray(array)
+    return _enqueue(BROADCAST, name, arr, root_rank=root_rank,
+                    out_shape=arr.shape)
+
+
+def broadcast(array, name, root_rank=0):
+    return broadcast_async(array, name, root_rank).wait()
+
+
+def reducescatter_async(array, name, op="sum", prescale=1.0, postscale=1.0):
+    """Reduce across ranks, scatter along dim 0: this rank receives rows
+    [rank*base + min(rank, rem) ...) of the reduction (remainder rows go
+    to the first ranks), matching the compiled path's dim-0 split."""
+    arr = np.ascontiguousarray(array)
+    d0 = arr.shape[0] if arr.ndim > 0 else 1
+    n = _lib.hvdc_size() if _lib is not None and _lib.hvdc_size() > 0 else 1
+    base, rem = divmod(d0, n)
+    r = _lib.hvdc_rank() if _lib is not None else 0
+    rows = base + (1 if r < rem else 0)
+    out_shape = (rows,) + arr.shape[1:]
+    return _enqueue(REDUCESCATTER, name, arr, _OP_MAP[op],
+                    out_shape=out_shape, prescale=prescale,
+                    postscale=postscale)
+
+
+def reducescatter(array, name, op="sum", **kw):
+    return reducescatter_async(array, name, op, **kw).wait()
+
+
+def alltoall_async(array, name):
+    arr = np.ascontiguousarray(array)
+    return _enqueue(ALLTOALL, name, arr, out_shape=arr.shape)
+
+
+def alltoall(array, name):
+    return alltoall_async(array, name).wait()
+
+
+def join():
+    """Announce data exhaustion; returns when every rank has joined
+    (reference EnqueueJoin, operations.cc:909)."""
+    lib = _load()
+    h = lib.hvdc_enqueue_join()
+    if h < 0:
+        raise RuntimeError("join: core not initialized")
+    rv = lib.hvdc_wait(h)
+    msg = lib.hvdc_error_message(h).decode()
+    lib.hvdc_release(h)
+    if rv != 1:
+        raise RuntimeError(f"join failed: {msg}")
+
+
+def barrier():
+    lib = _load()
+    if lib.hvdc_barrier() != 0:
+        raise RuntimeError("barrier failed")
+
+
+def control_bytes():
+    """Cumulative control-plane bytes (sent, received) in negotiation
+    rounds — the response-cache bitvector protocol shrinks these in
+    steady state."""
+    lib = _load()
+    sent = ctypes.c_int64(0)
+    recvd = ctypes.c_int64(0)
+    if lib.hvdc_control_bytes(ctypes.byref(sent), ctypes.byref(recvd)) != 0:
+        raise RuntimeError("native core is not initialized")
+    return sent.value, recvd.value
+
+
+def autotune_state():
+    """Autotuner snapshot: dict with ``enabled``, current
+    ``fusion_threshold`` / ``cycle_time_ms``, coordinator-side ``samples``
+    (-1 on workers) and ``done`` (reference: parameter_manager state)."""
+    lib = _load()
+    fusion = ctypes.c_int64(0)
+    cycle = ctypes.c_double(0.0)
+    samples = ctypes.c_int(0)
+    done = ctypes.c_int(0)
+    rv = lib.hvdc_autotune_state(ctypes.byref(fusion), ctypes.byref(cycle),
+                                 ctypes.byref(samples), ctypes.byref(done))
+    if rv < 0:
+        raise RuntimeError("native core is not initialized")
+    return {"enabled": bool(rv), "fusion_threshold": fusion.value,
+            "cycle_time_ms": cycle.value, "samples": samples.value,
+            "done": bool(done.value)}

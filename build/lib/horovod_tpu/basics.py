@@ -1,0 +1,191 @@
+"""Process-level lifecycle and identity: init / shutdown / rank / size.
+
+Rebuilds the surface of ``horovod/common/basics.py:22-213`` (ctypes calls
+into ``horovod_init``/``horovod_rank``/... exported at
+``horovod/common/operations.cc:641-778``) for TPU. Identity mapping:
+
+* ``rank()``/``size()``         — this process among all launched processes.
+  The ``hvdrun`` launcher starts one process per TPU chip (single-host) or
+  per TPU VM (multi-host pods), mirroring one-process-per-GPU in the
+  reference (``horovod/run/gloo_run.py:53-111`` slot allocation).
+* ``local_rank()``/``local_size()``   — within this host.
+* ``cross_rank()``/``cross_size()``   — across hosts/slices (DCN axis).
+* ``num_devices()``             — total TPU chips in the mesh; inside a
+  compiled step, the per-chip identity is ``mesh_rank()`` from
+  ``horovod_tpu.ops.collective``.
+
+Unlike the reference there is no background communication thread here: on
+TPU the data plane is compiled into the step function by XLA, so ``init()``
+only establishes identity, the mesh, and host-side services (controller
+client, timeline, stall inspector).
+"""
+
+import atexit
+import logging
+import os
+import threading
+
+import jax
+
+from horovod_tpu.config import Config
+from horovod_tpu.parallel import mesh as mesh_lib
+
+logger = logging.getLogger("horovod_tpu")
+
+_lock = threading.Lock()
+
+
+class _State:
+    """Process-global state (TPU analogue of ``HorovodGlobalState``,
+    ``horovod/common/global_state.h:42-122``, minus the background thread)."""
+
+    def __init__(self):
+        self.initialized = False
+        self.config = None
+        self.mesh = None
+        self.controller = None  # host-side controller client (set when used)
+        self.timeline = None
+        self.stall_inspector = None
+        self.joined = False
+
+
+_state = _State()
+
+
+def _configure_logging(cfg):
+    level = getattr(logging, cfg.log_level.upper(), logging.WARNING)
+    fmt = "[%(levelname)s rank " + str(cfg.rank) + "] %(message)s"
+    if not cfg.log_hide_timestamp:
+        fmt = "%(asctime)s " + fmt
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(fmt))
+    logger.handlers[:] = [handler]
+    logger.setLevel(level)
+
+
+def init(num_slices=None, devices=None):
+    """Initialize horovod_tpu (idempotent, like ``InitializeHorovodOnce``,
+    ``horovod/common/operations.cc:584``).
+
+    Reads the launcher env contract (``HOROVOD_RANK/SIZE/...``), joins the
+    multi-process JAX runtime when launched multi-process, and installs the
+    global device mesh.
+    """
+    with _lock:
+        if _state.initialized:
+            return
+        cfg = Config.from_env()
+        _configure_logging(cfg)
+
+        # Multi-process: join the distributed JAX runtime so jax.devices()
+        # spans every chip in the job. The coordinator address is provided by
+        # the hvdrun launcher (TPU analogue of the gloo rendezvous address,
+        # gloo_context.cc:41-50).
+        coord = os.environ.get("HOROVOD_COORDINATOR_ADDR")
+        if cfg.size > 1 and coord:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=cfg.size,
+                process_id=cfg.rank,
+            )
+
+        if num_slices is None:
+            num_slices = cfg.cross_size if cfg.cross_size > 1 else 1
+        m = mesh_lib.build_mesh(devices=devices, num_slices=num_slices)
+        mesh_lib.set_mesh(m)
+
+        _state.config = cfg
+        _state.mesh = m
+        _state.initialized = True
+
+        # Host-side services (timeline, stall inspector, controller client)
+        # attach lazily; see horovod_tpu.runtime.
+        from horovod_tpu.runtime import services
+        services.start(_state)
+
+        logger.info(
+            "horovod_tpu initialized: rank=%d size=%d local=%d/%d cross=%d/%d "
+            "mesh=%s devices=%d", cfg.rank, cfg.size, cfg.local_rank,
+            cfg.local_size, cfg.cross_rank, cfg.cross_size,
+            dict(zip(m.axis_names, m.devices.shape)), m.devices.size)
+    atexit.register(shutdown)
+
+
+def shutdown():
+    """Tear down host-side services (``horovod_shutdown``,
+    ``operations.cc:687``)."""
+    with _lock:
+        if not _state.initialized:
+            return
+        from horovod_tpu.runtime import services
+        services.stop(_state)
+        _state.initialized = False
+        _state.mesh = None
+        _state.config = None
+
+
+def is_initialized():
+    return _state.initialized
+
+
+def _cfg():
+    if not _state.initialized:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init()")
+    return _state.config
+
+
+def rank():
+    """Rank of this process among all launched processes."""
+    return _cfg().rank
+
+
+def size():
+    """Number of launched processes."""
+    return _cfg().size
+
+
+def local_rank():
+    return _cfg().local_rank
+
+
+def local_size():
+    return _cfg().local_size
+
+
+def cross_rank():
+    return _cfg().cross_rank
+
+
+def cross_size():
+    return _cfg().cross_size
+
+
+def num_devices():
+    """Total TPU chips in the global mesh (the data-parallel world size of
+    the compiled data plane)."""
+    if not _state.initialized:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init()")
+    return _state.mesh.devices.size
+
+
+def mesh():
+    """The global ``jax.sharding.Mesh`` installed by ``init()``."""
+    if not _state.initialized:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init()")
+    return _state.mesh
+
+
+def data_axes():
+    """Axis names gradients are reduced over, e.g. ``('data',)`` or
+    ``('dcn', 'data')``."""
+    return mesh_lib.data_axis_names(mesh())
+
+
+def mpi_threads_supported():
+    """Parity shim for ``hvd.mpi_threads_supported()``
+    (``horovod/common/basics.py``): there is no MPI on TPU VMs; the control
+    plane is TCP. Always False."""
+    return False

@@ -1,0 +1,164 @@
+"""Training callbacks + LR schedule helpers.
+
+Rebuilds the reference's Keras callback suite
+(``horovod/_keras/callbacks.py:20-185``) in two idiomatic forms:
+
+* **Callback objects** with ``on_train_begin/on_epoch_begin/on_epoch_end``
+  hooks for imperative loops (the torch adapter, or custom JAX loops).
+  LR-mutating callbacks operate on anything exposing ``param_groups``
+  (torch optimizers, incl. our DistributedOptimizer wrapper).
+* **optax schedule builders** (``warmup_schedule``, ``lr_schedule``) — the
+  compiled-world equivalent: the schedule is baked into the optimizer
+  rather than mutated per epoch.
+"""
+
+import numpy as np
+
+
+class Callback:
+    def on_train_begin(self, ctx=None):
+        pass
+
+    def on_epoch_begin(self, epoch, ctx=None):
+        pass
+
+    def on_epoch_end(self, epoch, metrics=None, ctx=None):
+        return metrics
+
+    def on_batch_begin(self, batch, ctx=None):
+        pass
+
+    def on_batch_end(self, batch, ctx=None):
+        pass
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial model/optimizer state from root at train start
+    (reference ``_keras/callbacks.py:20-45``; torch equivalent
+    ``broadcast_parameters``). ``ctx`` is a dict with any of
+    ``model`` (torch nn.Module) / ``optimizer`` / ``params`` (pytree)."""
+
+    def __init__(self, root_rank=0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, ctx=None):
+        ctx = ctx or {}
+        model = ctx.get("model")
+        if model is not None:
+            from horovod_tpu import torch as hvd_torch
+            hvd_torch.broadcast_parameters(model.state_dict(),
+                                           self.root_rank)
+        optimizer = ctx.get("optimizer")
+        if optimizer is not None:
+            from horovod_tpu import torch as hvd_torch
+            hvd_torch.broadcast_optimizer_state(optimizer, self.root_rank)
+        params = ctx.get("params")
+        if params is not None:
+            from horovod_tpu import hvd_jax
+            ctx["params"] = hvd_jax.broadcast_variables(
+                params, root_rank=self.root_rank)
+        return ctx
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all ranks (reference
+    ``_keras/callbacks.py:46-85``)."""
+
+    def on_epoch_end(self, epoch, metrics=None, ctx=None):
+        if not metrics:
+            return metrics
+        from horovod_tpu.ops import collective
+        return {
+            k: float(np.asarray(collective.allreduce(
+                np.asarray(v, dtype=np.float32), op=collective.Average)))
+            for k, v in metrics.items()
+        }
+
+
+def _set_lr(optimizer, lr):
+    for group in optimizer.param_groups:
+        group["lr"] = lr
+
+
+class LearningRateWarmupCallback(Callback):
+    """Ramp LR from ``initial_lr`` to ``initial_lr * size`` over the first
+    ``warmup_epochs`` (the linear-scaling warmup of Goyal et al., reference
+    ``_keras/callbacks.py:86-140``). Interpolates within epochs when
+    ``steps_per_epoch`` is given."""
+
+    def __init__(self, optimizer, initial_lr, warmup_epochs=5,
+                 steps_per_epoch=None, verbose=False):
+        from horovod_tpu import basics
+        self.optimizer = optimizer
+        self.initial_lr = initial_lr
+        self.target_lr = initial_lr * basics.size()
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._epoch = 0
+
+    def _lr_at(self, progress):
+        if progress >= self.warmup_epochs:
+            return self.target_lr
+        frac = progress / self.warmup_epochs
+        return self.initial_lr + (self.target_lr - self.initial_lr) * frac
+
+    def on_epoch_begin(self, epoch, ctx=None):
+        self._epoch = epoch
+        if self.steps_per_epoch is None:
+            _set_lr(self.optimizer, self._lr_at(epoch))
+
+    def on_batch_begin(self, batch, ctx=None):
+        if self.steps_per_epoch is not None:
+            _set_lr(self.optimizer,
+                    self._lr_at(self._epoch + batch / self.steps_per_epoch))
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply base LR by ``multiplier(epoch)`` from ``start_epoch`` on
+    (reference ``_keras/callbacks.py:141-185``)."""
+
+    def __init__(self, optimizer, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True):
+        self.optimizer = optimizer
+        self.multiplier = (multiplier if callable(multiplier)
+                           else (lambda _: multiplier))
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.base_lr = optimizer.param_groups[0]["lr"]
+
+    def on_epoch_begin(self, epoch, ctx=None):
+        if epoch < self.start_epoch:
+            return
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return
+        e = int(epoch) if self.staircase else epoch
+        _set_lr(self.optimizer, self.base_lr * self.multiplier(e))
+
+
+# ---------------------------------------------------------------------------
+# optax schedule builders — the compiled-path equivalents
+# ---------------------------------------------------------------------------
+
+
+def warmup_schedule(base_lr, size=None, warmup_steps=1000):
+    """optax schedule: linear ramp from base_lr to base_lr*size, then flat
+    (LearningRateWarmupCallback, compiled)."""
+    import optax
+
+    from horovod_tpu import basics
+    if size is None:
+        size = basics.size() if basics.is_initialized() else 1
+    return optax.join_schedules(
+        [optax.linear_schedule(base_lr, base_lr * size, warmup_steps),
+         optax.constant_schedule(base_lr * size)],
+        boundaries=[warmup_steps])
+
+
+def lr_schedule(base_lr, boundaries_and_scales):
+    """optax schedule: piecewise-constant decay
+    (LearningRateScheduleCallback, compiled). ``boundaries_and_scales``
+    maps step -> multiplicative scale, e.g. {30_000: 0.1, 60_000: 0.1}."""
+    import optax
+    return optax.piecewise_constant_schedule(base_lr, boundaries_and_scales)

@@ -1,0 +1,142 @@
+"""Checkpoint/resume conventions for distributed training.
+
+Rebuilds the reference's checkpoint discipline (SURVEY §5.4;
+``examples/keras_imagenet_resnet50.py:85-103,156-158``):
+
+* **only rank 0 writes** — other workers would corrupt the file,
+* the resume step is discovered on rank 0 and **broadcast** so every
+  worker starts the same epoch (reference ``hvd.broadcast(resume_from_
+  epoch, 0, name='resume_from_epoch')``),
+* after a rank-0 restore, parameters and optimizer state are **broadcast
+  from root** so all workers start identical (reference
+  ``BroadcastGlobalVariablesCallback(0)`` + ``hvd.load_model``).
+
+Pytrees are serialized with flax msgpack (TPU-idiomatic: works on any
+params/opt_state tree, jax or numpy arrays); writes are atomic
+(tmp + rename) so a worker killed mid-write never leaves a truncated
+checkpoint behind.
+"""
+
+import os
+import re
+
+import numpy as np
+
+_STEP_RE = re.compile(r"ckpt-(\d+)\.msgpack$")
+
+
+def _fmt(directory, step):
+    return os.path.join(directory, f"ckpt-{step}.msgpack")
+
+
+def save_checkpoint(directory, step, params, opt_state=None, meta=None,
+                    keep=None):
+    """Write ``ckpt-<step>.msgpack`` from rank 0 only; no-op elsewhere.
+
+    ``meta`` is a small JSON-able dict (e.g. epoch, rng seed). ``keep``
+    (int) prunes all but the newest N checkpoints after a successful
+    write."""
+    import json
+
+    from flax import serialization
+
+    import horovod_tpu as hvd
+    if hvd.rank() != 0:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    # meta rides as one JSON string leaf: flax from_bytes restores by the
+    # TARGET's structure, so a dict-of-unknown-keys would come back empty
+    payload = {"step": np.asarray(step, dtype=np.int64),
+               "params": params,
+               "opt_state": opt_state if opt_state is not None else {},
+               "meta": json.dumps(meta or {})}
+    data = serialization.to_bytes(payload)
+    path = _fmt(directory, step)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+    if keep:
+        steps = sorted(list_steps(directory))
+        for old in steps[:-keep]:
+            try:
+                os.remove(_fmt(directory, old))
+            except OSError:
+                pass
+    return path
+
+
+def list_steps(directory):
+    """Steps with a complete checkpoint in ``directory`` (rank-local)."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = _STEP_RE.match(name)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def resume_step(directory, default=0):
+    """The step every worker should resume from: rank 0 scans the
+    directory, the result is broadcast so workers agree even when the
+    checkpoint dir is rank-0-local (reference resume_from_epoch
+    broadcast, keras_imagenet_resnet50.py:85-88)."""
+    import horovod_tpu as hvd
+    if hvd.rank() == 0:
+        steps = list_steps(directory)
+        step = steps[-1] if steps else default
+    else:
+        step = default
+    if hvd.size() > 1:
+        step = int(np.asarray(
+            hvd.broadcast(np.asarray([step], dtype=np.int64),
+                          root_rank=0))[0])
+    return step
+
+
+def restore_checkpoint(directory, step, params, opt_state=None):
+    """Load ``ckpt-<step>`` into the given target trees (flax msgpack
+    needs the structure); returns ``(params, opt_state, meta)``.
+    Rank-local read — see :func:`restore_or_init` for the broadcast
+    discipline."""
+    import json
+
+    from flax import serialization
+    target = {"step": np.asarray(0, dtype=np.int64),
+              "params": params,
+              "opt_state": opt_state if opt_state is not None else {},
+              "meta": ""}
+    with open(_fmt(directory, step), "rb") as f:
+        restored = serialization.from_bytes(target, f.read())
+    return (restored["params"], restored["opt_state"],
+            json.loads(restored["meta"] or "{}"))
+
+
+def restore_or_init(directory, params, opt_state=None, axes=None):
+    """The full resume convention in one call:
+
+    1. rank 0 discovers the newest checkpoint; the step is broadcast,
+    2. if one exists, **rank 0** restores it (other ranks keep their
+       fresh init),
+    3. params (and opt_state) are broadcast from root so every worker
+       starts identical — whether restored or freshly initialized.
+
+    Returns ``(step, params, opt_state)`` with ``step == 0`` when no
+    checkpoint existed. Designed for the eager (pre-jit) phase of a
+    training script; inside shard_map use ``hvd.broadcast_variables``
+    directly."""
+    import horovod_tpu as hvd
+    step = resume_step(directory)
+    if step > 0 and hvd.rank() == 0:
+        params, opt_state, _meta = restore_checkpoint(
+            directory, step, params, opt_state)
+    if hvd.size() > 1:
+        params = hvd.broadcast_parameters(params, root_rank=0)
+        if opt_state is not None:
+            opt_state = hvd.broadcast_optimizer_state(opt_state,
+                                                      root_rank=0)
+    return step, params, opt_state

@@ -1,0 +1,141 @@
+"""Environment-variable configuration knobs.
+
+Mirrors the reference's env-knob surface (``horovod/common/common.h:61-88``,
+parsed at ``horovod/common/operations.cc:387-484`` and
+``horovod/common/utils/env_parser.cc``) with the same ``HOROVOD_*`` names so
+users of the reference find the knobs they know. Launcher rank contract
+mirrors ``horovod/run/gloo_run.py:210-236``.
+"""
+
+import dataclasses
+import os
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return float(v)
+
+
+def _env_bool(name, default=False):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.lower() not in ("0", "false", "no", "off")
+
+
+def _env_str(name, default=None):
+    v = os.environ.get(name)
+    return default if v in (None, "") else v
+
+
+# Default tensor-fusion buffer size: 64 MB, matching the reference default
+# (horovod/common/operations.cc:403).
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+# Background-cycle time in ms (reference default 5 ms, operations.cc:407).
+DEFAULT_CYCLE_TIME_MS = 5.0
+# Response-cache capacity (reference default 1024, global_state.h:88).
+DEFAULT_CACHE_CAPACITY = 1024
+# Stall-warning threshold in seconds (reference 60 s, stall_inspector.h).
+DEFAULT_STALL_WARNING_TIME = 60.0
+
+
+@dataclasses.dataclass
+class Config:
+    """Snapshot of all HOROVOD_* knobs at ``init()`` time."""
+
+    # --- process identity (set by the hvdrun launcher; gloo_run.py:210) ---
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+
+    # --- control plane (reference: HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT) ---
+    controller_addr: str = None
+    controller_port: int = 0
+    rendezvous_addr: str = None
+    rendezvous_port: int = 0
+
+    # --- data plane tuning ---
+    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    batch_d2d_memcopies: bool = True
+
+    # --- observability ---
+    timeline: str = None
+    timeline_mark_cycles: bool = False
+    log_level: str = "warning"
+    log_hide_timestamp: bool = False
+
+    # --- stall inspector (stall_inspector.h:30-70) ---
+    stall_check_disable: bool = False
+    stall_warning_time: float = DEFAULT_STALL_WARNING_TIME
+    stall_shutdown_time: float = 0.0
+
+    # --- autotune (parameter_manager.h) ---
+    autotune: bool = False
+    autotune_log: str = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+
+    # --- adasum ---
+    adasum_chunk_size: int = 1 << 26
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        return cls(
+            rank=_env_int("HOROVOD_RANK", 0),
+            size=_env_int("HOROVOD_SIZE", 1),
+            local_rank=_env_int("HOROVOD_LOCAL_RANK", 0),
+            local_size=_env_int("HOROVOD_LOCAL_SIZE", 1),
+            cross_rank=_env_int("HOROVOD_CROSS_RANK", 0),
+            cross_size=_env_int("HOROVOD_CROSS_SIZE", 1),
+            controller_addr=_env_str("HOROVOD_CONTROLLER_ADDR"),
+            controller_port=_env_int("HOROVOD_CONTROLLER_PORT", 0),
+            rendezvous_addr=_env_str("HOROVOD_GLOO_RENDEZVOUS_ADDR"),
+            rendezvous_port=_env_int("HOROVOD_GLOO_RENDEZVOUS_PORT", 0),
+            fusion_threshold=_env_int(
+                "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD),
+            cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME",
+                                     DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY",
+                                    DEFAULT_CACHE_CAPACITY),
+            hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
+            hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            batch_d2d_memcopies=_env_bool("HOROVOD_BATCH_D2D_MEMCOPIES", True),
+            timeline=_env_str("HOROVOD_TIMELINE"),
+            timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+            log_level=_env_str("HOROVOD_LOG_LEVEL", "warning"),
+            log_hide_timestamp=_env_bool("HOROVOD_LOG_HIDE_TIME"),
+            stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
+            stall_warning_time=_env_float(
+                "HOROVOD_STALL_CHECK_TIME_SECONDS", DEFAULT_STALL_WARNING_TIME),
+            stall_shutdown_time=_env_float(
+                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+            autotune=_env_bool("HOROVOD_AUTOTUNE"),
+            autotune_log=_env_str("HOROVOD_AUTOTUNE_LOG"),
+            autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+                                             3),
+            autotune_steps_per_sample=_env_int(
+                "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            autotune_bayes_opt_max_samples=_env_int(
+                "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20),
+            autotune_gaussian_process_noise=_env_float(
+                "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8),
+            adasum_chunk_size=_env_int("HOROVOD_ADASUM_CHUNK_SIZE", 1 << 26),
+        )

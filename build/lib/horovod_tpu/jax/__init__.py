@@ -1,0 +1,26 @@
+"""``horovod_tpu.jax`` — framework-adapter namespace for JAX.
+
+Mirrors the reference's per-framework layout (``horovod/tensorflow``,
+``horovod/torch``, ``horovod/mxnet``): everything user-facing for JAX in one
+place. Implementation lives in ``horovod_tpu.hvd_jax`` (module named to
+avoid confusion with the top-level ``jax`` package in tracebacks).
+"""
+
+from horovod_tpu.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, num_devices, mesh, data_axes,
+    mpi_threads_supported,
+)
+from horovod_tpu.ops.collective import (  # noqa: F401
+    Sum, Average, Adasum, Min, Max,
+    allreduce, allgather, broadcast, reducescatter, alltoall,
+    mesh_rank, mesh_size,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu.ops.fusion import fused_allreduce  # noqa: F401
+from horovod_tpu.hvd_jax import (  # noqa: F401
+    DistributedOptimizer, DistributedGradientTransform,
+    distributed_grad, distributed_value_and_grad,
+    broadcast_variables, broadcast_parameters, broadcast_optimizer_state,
+    allreduce_metrics, join,
+)

@@ -1,0 +1,50 @@
+"""Small example models: the MNIST-scale nets of the reference's examples.
+
+Reference: the ``Net`` in ``/root/reference/examples/pytorch_mnist.py:44-60``
+(conv-conv-fc-fc with dropout) and the Keras MNIST models
+(``examples/keras_mnist.py``). These are fresh flax implementations with the
+same capacity class, used by ``examples/`` and the MNIST tests.
+"""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MNISTConvNet(nn.Module):
+    """conv(32) -> conv(64) -> fc(128) -> fc(10), the classic MNIST net."""
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class MLP(nn.Module):
+    """Plain MLP for smoke tests and the linear-regression examples."""
+    features: Sequence[int] = (128, 128, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x.astype(jnp.float32)

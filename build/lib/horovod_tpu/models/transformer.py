@@ -1,0 +1,130 @@
+"""Decoder-only Transformer with first-class sequence parallelism.
+
+Not present in the 2019 reference (SURVEY.md §5.7: long-context machinery is
+absent there) — built here because long-context is a first-class requirement
+of the TPU framework. Design:
+
+* Pre-RMSNorm, rotary position embeddings, GELU MLP — the standard modern
+  decoder block, all shapes static and MXU-friendly (bf16 compute).
+* ``sequence_axis``: when set (inside shard_map over that mesh axis), the
+  sequence dimension is sharded across the axis and attention runs as
+  **ring attention** (``horovod_tpu.parallel.ring``): K/V blocks rotate
+  around the ring via ``lax.ppermute`` while each shard's Q stays put,
+  with online-softmax accumulation — memory per chip stays O(S/n), enabling
+  contexts n× longer than a single chip could hold.
+* Causal masking composes with the ring: block pairs that are entirely
+  in the future are still computed (static shapes) but masked.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    dtype: Any = jnp.bfloat16
+    causal: bool = True
+    # mesh axis the sequence dim is sharded over (ring attention), or None
+    sequence_axis: Optional[str] = None
+
+
+def _rotary(x, positions):
+    """Apply rotary position embedding. x: [B, S, H, D], positions: [B, S]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1)
+
+
+def dense_attention(q, k, v, *, causal, q_positions, kv_positions):
+    """Single-device attention: softmax(QK^T/sqrt(d)) V with causal mask by
+    absolute position (so it composes with sequence-sharded inputs)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    scores = scores.astype(jnp.float32) / (float(d) ** 0.5)
+    if causal:
+        mask = q_positions[:, None, :, None] >= kv_positions[:, None, None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        h, d = cfg.num_heads, cfg.d_model // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (h, d), axis=-1, dtype=cfg.dtype, use_bias=False, name=name)
+        q = _rotary(dense("query")(x), positions)
+        k = _rotary(dense("key")(x), positions)
+        v = dense("value")(x)
+        if cfg.sequence_axis is not None:
+            from horovod_tpu.parallel import ring
+            out = ring.ring_attention(
+                q, k, v, axis_name=cfg.sequence_axis, causal=cfg.causal,
+                q_positions=positions, kv_positions=positions)
+        else:
+            out = dense_attention(q, k, v, causal=cfg.causal,
+                                  q_positions=positions,
+                                  kv_positions=positions)
+        return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+                               use_bias=False, name="out")(out)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        y = nn.RMSNorm(dtype=cfg.dtype)(x)
+        x = x + Attention(cfg, name="attn")(y, positions)
+        y = nn.RMSNorm(dtype=cfg.dtype)(x)
+        y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=False)(y)
+        return x + y
+
+
+class Transformer(nn.Module):
+    """tokens [B, S_local] -> logits [B, S_local, vocab].
+
+    With ``cfg.sequence_axis`` set, S_local = S_global / axis_size and
+    ``positions`` must carry each shard's absolute positions (the training
+    utilities compute them from the shard index).
+    """
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, train: bool = True):
+        del train
+        cfg = self.cfg
+        if positions is None:
+            from horovod_tpu.parallel.ring import default_positions
+            positions = default_positions(cfg.sequence_axis,
+                                          tokens.shape[0], tokens.shape[1])
+        x = nn.Embed(cfg.vocab_size, cfg.d_model,
+                     dtype=cfg.dtype, name="embed")(tokens)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x, positions)
+        x = nn.RMSNorm(dtype=cfg.dtype)(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, use_bias=False,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,41 @@
+"""VGG-16, the bandwidth-bound member of the reference's benchmark trio.
+
+Reference baseline: 68% scaling efficiency at 512 GPUs (``README.rst:77``) —
+VGG's 138M mostly-fc parameters stress gradient-allreduce bandwidth, which
+is exactly what the fusion + hierarchical-reduction paths exist for. Fresh
+flax implementation (the reference uses tf_cnn_benchmarks' VGG).
+"""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# channels per conv stage; 'M' marks max-pool (the standard VGG-16 "D" cfg)
+_VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    cfg: Sequence = _VGG16_CFG
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME", dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,12 @@
+"""Collective op implementations (the TPU data plane).
+
+Reference equivalent: ``horovod/common/ops/`` — MPI/NCCL/Gloo/CCL op chains
+(``operation_manager.h:26-61``). On TPU there is one backend: XLA collectives
+compiled over the device mesh (ICI within a slice, DCN across slices), so
+the "op chain" collapses to named-axis primitives plus fusion, compression,
+hierarchical, and Adasum layers on top.
+"""
+
+from horovod_tpu.ops import collective, compression, fusion, adasum
+
+__all__ = ["collective", "compression", "fusion", "adasum"]

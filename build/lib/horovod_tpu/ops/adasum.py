@@ -1,0 +1,117 @@
+"""Adasum: scale-insensitive gradient combination (Microsoft).
+
+Reference: header-only templated implementation with AVX fp16 intrinsics and
+an MPI recursive vector-halving distance-doubling schedule
+(``horovod/common/ops/adasum/adasum.h:186-330`` ``FusedAllreduce``,
+pairwise combine at ``adasum.h:331+``; MPI instantiation
+``adasum_mpi.cc``; hierarchical GPU variant ``adasum_cuda_operations.cc``).
+
+The pairwise operator for gradients a, b is::
+
+    combined = a * (1 - dot(a,b) / (2*||a||^2))
+             + b * (1 - dot(a,b) / (2*||b||^2))
+
+applied recursively over a binary tree of ranks (power-of-2 world size,
+same constraint as the reference). TPU-native realization: each tree level
+is a full-vector ``ppermute`` exchange with the XOR partner followed by the
+combine, entirely inside the compiled step — the dot products and norms are
+accumulated in **float32** regardless of wire dtype (the reference needs
+hand-written AVX fp16 dot kernels for this; on TPU we just ask XLA for f32
+accumulation).
+
+The tree order is identical to the reference's recursive-halving schedule,
+so a NumPy reference model (see ``tests/test_adasum.py``) reproduces results
+bit-for-bit in f32.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def adasum_combine(a, b, eps=0.0):
+    """The Adasum pairwise operator (``adasum.h:331+``). Falls back to plain
+    sum when either operand has zero norm (matching reference behavior of
+    the ratio terms vanishing)."""
+    af = a.astype(jnp.float32).ravel()
+    bf = b.astype(jnp.float32).ravel()
+    dot = jnp.dot(af, bf)
+    na2 = jnp.dot(af, af)
+    nb2 = jnp.dot(bf, bf)
+    ca = jnp.where(na2 > eps, 1.0 - dot / (2.0 * jnp.where(na2 > eps, na2, 1.0)), 1.0)
+    cb = jnp.where(nb2 > eps, 1.0 - dot / (2.0 * jnp.where(nb2 > eps, nb2, 1.0)), 1.0)
+    out = af * ca + bf * cb
+    return out.reshape(a.shape).astype(a.dtype)
+
+
+def adasum_allreduce(x, axes):
+    """Adasum-reduce ``x`` across the shards of ``axes`` (power-of-2 count).
+
+    Tree schedule: at level l each shard exchanges its current vector with
+    partner ``rank ^ 2**l`` and both compute the same combined result —
+    the distance-doubling pairing of ``adasum.h:186-330`` with full-vector
+    exchange instead of vector-halving (bandwidth traded for static shapes
+    and zero host coordination; the tree and therefore the numerics are
+    identical).
+    """
+    if isinstance(axes, str):
+        axes = (axes,)
+    if len(axes) > 1:
+        # Hierarchical variant (adasum_cuda_operations.cc): average over the
+        # inner (ICI) axes first, Adasum across the outer (DCN) axis.
+        outer = axes[0]
+        inner = tuple(axes[1:])
+        x = lax.pmean(x, inner)
+        return adasum_allreduce(x, (outer,))
+    axis = axes[0]
+    size = lax.axis_size(axis)
+    if size & (size - 1):
+        raise ValueError(
+            f"Adasum requires a power-of-2 number of shards, got {size} "
+            "(same constraint as the reference, adasum.h)")
+    levels = int(np.log2(size))
+    me = lax.axis_index(axis)
+    out = x
+    for level in range(levels):
+        d = 1 << level
+        perm = [(i, i ^ d) for i in range(size)]
+        other = lax.ppermute(out, axis, perm)
+        # Order the operands canonically (lower rank first) so both partners
+        # compute the identical combined vector.
+        is_low = (me & d) == 0
+        a = jnp.where(is_low, out, other)
+        b = jnp.where(is_low, other, out)
+        out = adasum_combine(a, b)
+    return out
+
+
+def adasum_combine_np(a, b):
+    """NumPy reference of the pairwise operator, for tests (pattern of
+    ``test/test_adasum_tensorflow.py:33-63`` in the reference: reimplement
+    the formula independently and compare)."""
+    af = a.astype(np.float32).ravel()
+    bf = b.astype(np.float32).ravel()
+    dot = float(np.dot(af, bf))
+    na2 = float(np.dot(af, af))
+    nb2 = float(np.dot(bf, bf))
+    ca = 1.0 - dot / (2.0 * na2) if na2 > 0 else 1.0
+    cb = 1.0 - dot / (2.0 * nb2) if nb2 > 0 else 1.0
+    return (af * ca + bf * cb).reshape(a.shape)
+
+
+def adasum_tree_np(vectors):
+    """NumPy reference of the full tree schedule over a power-of-2 list."""
+    vecs = [np.asarray(v, dtype=np.float32) for v in vectors]
+    size = len(vecs)
+    assert size & (size - 1) == 0
+    level = 0
+    while (1 << level) < size:
+        d = 1 << level
+        nxt = list(vecs)
+        for i in range(size):
+            j = i ^ d
+            a, b = (vecs[i], vecs[j]) if i < j else (vecs[j], vecs[i])
+            nxt[i] = adasum_combine_np(a, b)
+        vecs = nxt
+        level += 1
+    return vecs[0]

@@ -1,0 +1,201 @@
+"""Static tensor fusion: pack many small tensors into few big collectives.
+
+The reference fuses at runtime: a background thread packs ready tensors into
+a 64 MB fusion buffer each cycle and launches one collective per fused batch
+(``FuseResponses``, ``horovod/common/controller.cc:639-769``;
+``MemcpyInFusionBuffer``, ``horovod/common/ops/collective_operations.cc``).
+That design fights XLA: a different fused set each step means a different
+collective shape and a recompile.
+
+The TPU-native design fuses **statically at trace time**: the gradient
+pytree is flattened, leaves are grouped by dtype and packed in traversal
+order into flat buckets of at most ``fusion_threshold`` bytes (default 64 MB,
+matching ``operations.cc:403``), one collective is emitted per bucket, and
+XLA sees the same shapes every step — compile once, zero renegotiation.
+This is strictly stronger than the reference's steady-state response-cache
+path (``response_cache.h:45-102``): the "cache hit" is baked into the
+executable.
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops import collective
+
+
+@dataclasses.dataclass(frozen=True)
+class _Bucket:
+    """One fusion buffer: which flat leaves it packs and where."""
+    dtype: object
+    leaf_indices: tuple  # indices into the flattened leaf list
+    sizes: tuple         # element count per packed leaf
+    shapes: tuple        # original shape per packed leaf
+
+
+def plan_buckets(leaves, threshold_bytes):
+    """Greedy packing of leaves into dtype-homogeneous buckets of at most
+    ``threshold_bytes`` (a single leaf larger than the threshold gets its own
+    bucket, like a single tensor larger than the reference's fusion buffer,
+    ``controller.cc:687-696``)."""
+    by_dtype = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    buckets = []
+    for dtype, idxs in by_dtype.items():
+        itemsize = np.dtype(dtype).itemsize
+        cur, cur_bytes = [], 0
+        for i in idxs:
+            nbytes = int(np.prod(np.shape(leaves[i]))) * itemsize
+            if cur and cur_bytes + nbytes > threshold_bytes:
+                buckets.append(_make_bucket(dtype, cur, leaves))
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+        if cur:
+            buckets.append(_make_bucket(dtype, cur, leaves))
+    return buckets
+
+
+def _make_bucket(dtype, idxs, leaves):
+    return _Bucket(
+        dtype=dtype,
+        leaf_indices=tuple(idxs),
+        sizes=tuple(int(np.prod(np.shape(leaves[i])) or 1) for i in idxs),
+        shapes=tuple(tuple(np.shape(leaves[i])) for i in idxs),
+    )
+
+
+def _pack(bucket, leaves):
+    return jnp.concatenate(
+        [jnp.ravel(leaves[i]) for i in bucket.leaf_indices])
+
+
+def _unpack(bucket, flat):
+    out = {}
+    offset = 0
+    for i, size, shape in zip(bucket.leaf_indices, bucket.sizes,
+                              bucket.shapes):
+        out[i] = flat[offset:offset + size].reshape(shape)
+        offset += size
+    return out
+
+
+def fused_allreduce(tree, op=collective.Average, axes=None,
+                    compression=None, threshold_bytes=None,
+                    hierarchical=None):
+    """Allreduce every leaf of ``tree`` using fused flat buckets.
+
+    This is the gradient hot path — the TPU equivalent of the reference's
+    fuse → collective → unfuse cycle (``PerformOperation``,
+    ``operations.cc:227-304``), fully compiled.
+
+    ``hierarchical`` forces the two-level ICI x DCN reduction (reference:
+    ``NCCLHierarchicalAllreduce``, ``nccl_operations.cc:150-346``); default
+    auto-enables it when the mesh has a dcn axis and config asks for it.
+    """
+    from horovod_tpu import basics
+    from horovod_tpu.config import DEFAULT_FUSION_THRESHOLD
+    from horovod_tpu.parallel import hierarchical as hier_lib
+    from horovod_tpu.parallel.mesh import DCN_AXIS
+
+    if threshold_bytes is None:
+        cfg = basics._state.config
+        threshold_bytes = (cfg.fusion_threshold if cfg is not None
+                           else DEFAULT_FUSION_THRESHOLD)
+    if hierarchical is None:
+        cfg = basics._state.config
+        hierarchical = cfg.hierarchical_allreduce if cfg is not None else False
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    axes = collective._resolve_axes(axes)
+    buckets = plan_buckets(leaves, threshold_bytes)
+
+    new_leaves = [None] * len(leaves)
+    for bucket in buckets:
+        flat = _pack(bucket, leaves)
+        if compression is not None:
+            flat, ctx = compression.compress(flat)
+        if hierarchical and DCN_AXIS in axes and len(axes) > 1:
+            ici_axes = tuple(a for a in axes if a != DCN_AXIS)
+            flat = hier_lib.hierarchical_allreduce(
+                flat, ici_axes=ici_axes, dcn_axis=DCN_AXIS, op=op)
+        else:
+            flat = collective.allreduce(flat, op=op, axes=axes)
+        if compression is not None:
+            flat = compression.decompress(flat, ctx)
+        for i, arr in _unpack(bucket, flat).items():
+            new_leaves[i] = arr.astype(jnp.asarray(leaves[i]).dtype)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
+                              candidates=None, trials=10, apply=True):
+    """Pick the fusion bucket threshold by timed trials at init.
+
+    The compiled-path analogue of the reference autotuner's
+    fusion-threshold search (``parameter_manager.h:186-220``): on TPU the
+    fused set is static per executable, so instead of online Bayesian
+    optimization over cycles, we compile one executable per candidate
+    threshold, time the fused allreduce of the actual gradient pytree on
+    the real mesh, and keep the fastest. With ``apply=True`` (default)
+    the winner becomes the process-wide default ``fusion_threshold`` used
+    by ``fused_allreduce`` / ``DistributedOptimizer``.
+
+    Returns ``(best_threshold_bytes, {threshold: seconds})``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import basics
+    from horovod_tpu.parallel import mesh as mesh_lib
+
+    if candidates is None:
+        candidates = [1 << 20, 4 << 20, 16 << 20, 64 << 20]
+    try:
+        mesh = mesh_lib.get_mesh()
+    except RuntimeError:
+        mesh = None
+    axes_t = collective._resolve_axes(axes) if mesh is not None else axes
+
+    timings = {}
+    for thr in candidates:
+        def f(t, _thr=thr):
+            return fused_allreduce(t, op=op, axes=axes_t,
+                                   threshold_bytes=_thr)
+        if mesh is not None:
+            spec = jax.tree_util.tree_map(lambda _: P(), tree)
+            f = jax.shard_map(f, mesh=mesh, in_specs=(spec,),
+                              out_specs=spec, check_vma=False)
+        jf = jax.jit(f)
+        out = jf(tree)
+        jax.block_until_ready(out)  # compile outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = jf(tree)
+        jax.block_until_ready(out)
+        timings[thr] = time.perf_counter() - t0
+
+    # Multi-process: every rank must install the SAME winner, or ranks
+    # would plan different bucket structures and emit mismatched
+    # collectives. Sum the timings across ranks, then argmin — a
+    # deterministic, globally identical choice.
+    from horovod_tpu import _core
+    if _core.is_initialized() and _core.size() > 1:
+        vals = np.asarray([timings[c] for c in candidates], np.float64)
+        n = _AUTOTUNE_CALLS.setdefault("n", 0)
+        _AUTOTUNE_CALLS["n"] = n + 1
+        summed = _core.allreduce(vals, f"autotune.fusion.{n}", op="sum")
+        timings = {c: float(s) for c, s in zip(candidates, summed)}
+
+    best = min(timings, key=timings.get)
+    if apply and basics._state.config is not None:
+        basics._state.config.fusion_threshold = best
+    return best, timings
+
+
+_AUTOTUNE_CALLS = {}

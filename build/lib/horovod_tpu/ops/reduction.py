@@ -1,0 +1,13 @@
+"""Framework-neutral reduction-op constants.
+
+The wire-level op names shared by every adapter (JAX, torch, TF, MXNet)
+and the native core (reference: ``horovod/common/message.h:46-49`` request
+types plus the Min/Max extension). A dependency-free module so adapters
+for absent frameworks never drag in another framework at import time.
+"""
+
+Sum = "sum"
+Average = "average"
+Adasum = "adasum"
+Min = "min"
+Max = "max"

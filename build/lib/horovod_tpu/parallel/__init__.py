@@ -1,0 +1,24 @@
+"""Device-mesh management and parallelism strategies (TPU-native).
+
+The reference scales via process-level NCCL/MPI communicators
+(``horovod/common/mpi/mpi_context.cc``, LOCAL/CROSS communicator split at
+``mpi_controller.cc:25-86``). The TPU-native equivalent is a
+``jax.sharding.Mesh`` whose axes map onto the interconnect hierarchy:
+``ici`` (intra-slice, fast torus links) and ``dcn`` (inter-slice data-center
+network), with XLA emitting the collectives.
+"""
+
+from horovod_tpu.parallel.mesh import (
+    build_mesh,
+    get_mesh,
+    set_mesh,
+    data_axis_names,
+    DATA_AXIS,
+    DCN_AXIS,
+)
+from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+
+__all__ = [
+    "build_mesh", "get_mesh", "set_mesh", "data_axis_names",
+    "DATA_AXIS", "DCN_AXIS", "hierarchical_allreduce",
+]

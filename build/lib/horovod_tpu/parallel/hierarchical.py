@@ -1,0 +1,52 @@
+"""Two-level hierarchical allreduce: ICI within a slice, DCN across slices.
+
+Reference: ``NCCLHierarchicalAllreduce`` (``nccl_operations.cc:150-346``) —
+intra-node ncclReduceScatter → cross-node MPI_Allreduce → intra-node
+ncclAllGather, with a remainder handled separately and fusion-buffer
+divisibility constraints (``controller.cc:348-366``).
+
+TPU-native version: the same reduce-scatter / allreduce / all-gather
+algebra expressed over mesh axes, but padding replaces the remainder path
+(static shapes; XLA requires equal shards) and there are no D2H/H2D hops —
+the DCN transfer is a compiled collective on device-resident data.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
+
+
+def hierarchical_allreduce(x, ici_axes=(DATA_AXIS,), dcn_axis=DCN_AXIS,
+                           op="average"):
+    """Allreduce ``x`` over ``ici_axes + (dcn_axis,)`` in three stages:
+
+    1. reduce-scatter over the ICI axes (bandwidth-optimal on the torus),
+    2. allreduce of the 1/ici_size shard over DCN (cross-slice traffic is
+       reduced by a factor of ici_size — the whole point of the hierarchy,
+       same as the reference's per-local-rank parallel MPI_Allreduce),
+    3. all-gather over the ICI axes.
+    """
+    if isinstance(ici_axes, str):
+        ici_axes = (ici_axes,)
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    ici_size = 1
+    for a in ici_axes:
+        ici_size *= lax.axis_size(a)
+    pad = (-n) % ici_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = flat
+    for a in ici_axes:
+        shard = lax.psum_scatter(shard, a, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, dcn_axis)
+    out = shard
+    for a in reversed(ici_axes):
+        out = lax.all_gather(out, a, axis=0, tiled=True)
+    out = out[:n].reshape(shape)
+    if op == "average":
+        total = ici_size * lax.axis_size(dcn_axis)
+        out = out / total
+    return out

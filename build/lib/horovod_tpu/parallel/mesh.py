@@ -1,0 +1,70 @@
+"""Build and track the global device mesh.
+
+TPU-native replacement for the reference's communicator setup
+(``horovod/common/mpi/mpi_context.cc:25-86`` — GLOBAL/LOCAL/CROSS
+communicator split; ``horovod/common/gloo/gloo_context.cc:30-56`` — the
+gloo equivalent). Instead of three process communicators we build one
+``jax.sharding.Mesh`` whose axes express the same hierarchy:
+
+* ``data``  — the intra-slice (ICI) data-parallel axis. Collectives over it
+  compile to ICI all-reduces (the role NCCL plays in the reference).
+* ``dcn``   — the inter-slice axis, present only when spanning multiple TPU
+  slices. Collectives over it ride the data-center network (the role the
+  CROSS MPI communicator plays in
+  ``horovod/common/ops/nccl_operations.cc:150-346``).
+"""
+
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+DCN_AXIS = "dcn"
+
+_lock = threading.Lock()
+_current_mesh = None
+
+
+def build_mesh(devices=None, num_slices=1, axis_names=(DCN_AXIS, DATA_AXIS)):
+    """Build the global mesh over ``devices``.
+
+    ``num_slices > 1`` produces a 2-D ``(dcn, data)`` mesh so callers can
+    express hierarchical reductions (reduce-scatter over ICI, all-reduce over
+    DCN, all-gather over ICI) — the TPU analogue of
+    ``NCCLHierarchicalAllreduce`` (``nccl_operations.cc:150``). Otherwise the
+    mesh is 1-D ``(data,)``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+    if num_slices > 1:
+        if n % num_slices != 0:
+            raise ValueError(
+                f"device count {n} not divisible by num_slices {num_slices}")
+        dev_grid = devices.reshape(num_slices, n // num_slices)
+        return Mesh(dev_grid, axis_names)
+    return Mesh(devices.reshape(n), (axis_names[-1],))
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    with _lock:
+        _current_mesh = mesh
+
+
+def get_mesh():
+    """The mesh installed by ``horovod_tpu.init()`` (or ``set_mesh``)."""
+    with _lock:
+        if _current_mesh is None:
+            raise RuntimeError(
+                "horovod_tpu mesh is not set; call horovod_tpu.init() first")
+        return _current_mesh
+
+
+def data_axis_names(mesh=None):
+    """All mesh axes that gradients are reduced over (data + dcn)."""
+    mesh = mesh if mesh is not None else get_mesh()
+    return tuple(a for a in mesh.axis_names if a in (DCN_AXIS, DATA_AXIS))

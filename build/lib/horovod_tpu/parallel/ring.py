@@ -1,0 +1,123 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Absent from the 2019 reference (SURVEY.md §5.7) but first-class here: the
+mesh machinery that gives data parallelism also gives sequence sharding.
+Two interchangeable strategies, both compiled by XLA over ICI:
+
+* **Ring attention** (``ring_attention``): Q stays resident per shard; K/V
+  blocks rotate around the mesh-axis ring via ``lax.ppermute`` while
+  attention accumulates with the online-softmax (flash) recurrence in fp32.
+  Per-chip memory stays O(S/n); the ppermute overlaps with the block
+  matmuls in XLA's schedule. This is the TPU-idiomatic form of
+  Ring Attention (Liu et al. 2023) — see PAPERS.md.
+* **Ulysses** (``ulysses_attention``): one ``all_to_all`` re-shards from
+  sequence-sharded/full-heads to head-sharded/full-sequence, runs dense
+  attention locally, and reverses. Cheaper at moderate S, needs
+  num_heads % axis_size == 0.
+
+Causality is enforced by **absolute positions**, so both compose with any
+ring order and with unequal offsets.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = jnp.float32(-1e30)
+
+
+def default_positions(axis_name, batch, seq_local):
+    """Absolute token positions for a sequence-sharded [B, S_local] block:
+    this shard's offset on the ring plus the local arange. The single source
+    of truth for the position formula used by causal masking."""
+    offset = lax.axis_index(axis_name) * seq_local if axis_name else 0
+    return (offset + jnp.arange(seq_local))[None, :] * jnp.ones(
+        (batch, 1), jnp.int32)
+
+
+def _block_update(q, k, v, q_pos, kv_pos, m, l, o, causal, scale):
+    """One online-softmax accumulation step against a K/V block (fp32).
+
+    q: [B,Sq,H,D]; k,v: [B,Sk,H,D]; m,l: [B,H,Sq]; o: [B,H,Sq,D].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None, :, None] >= kv_pos[:, None, None, :]
+        s = jnp.where(mask, s, _NEG_BIG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp(-1e30 - m_new) could overflow to 1 when the whole row is masked
+    # (m_new == -1e30); zero those probabilities explicitly instead.
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(s <= _NEG_BIG, 0.0, p)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name, causal=True, q_positions=None,
+                   kv_positions=None):
+    """Blockwise ring attention over the ``axis_name`` mesh axis.
+
+    Shapes per shard: q/k/v ``[B, S_local, H, D]``; positions ``[B, S_local]``
+    absolute token positions (used for causal masking across shards).
+    Returns ``[B, S_local, H, D]`` in q.dtype.
+    """
+    n = lax.axis_size(axis_name)
+    b, sq, h, d = q.shape
+    scale = 1.0 / (float(d) ** 0.5)
+    if q_positions is None:
+        q_positions = default_positions(axis_name, b, sq)
+    if kv_positions is None:
+        kv_positions = q_positions
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, _):
+        k_blk, v_blk, kv_pos, m, l, o = carry
+        m, l, o = _block_update(q, k_blk, v_blk, q_positions, kv_pos,
+                                m, l, o, causal, scale)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        kv_pos = lax.ppermute(kv_pos, axis_name, perm)
+        return (k_blk, v_blk, kv_pos, m, l, o), None
+
+    m0 = jnp.full((b, h, sq), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    (_, _, _, m, l, o), _ = lax.scan(
+        step, (k, v, kv_positions, m0, l0, o0), None, length=n)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=True, q_positions=None,
+                      kv_positions=None):
+    """Ulysses-style sequence parallelism: all-to-all from sequence-sharded
+    to head-sharded, dense attention on the full sequence, and back.
+    Requires ``num_heads % axis_size == 0``."""
+    from horovod_tpu.models.transformer import dense_attention
+
+    n = lax.axis_size(axis_name)
+    b, sq, h, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"num_heads {h} not divisible by axis size {n}")
+    if q_positions is None:
+        q_positions = default_positions(axis_name, b, sq)
+    if kv_positions is None:
+        kv_positions = q_positions
+
+    def to_heads(x):  # [B,S/n,H,D] -> [B,S,H/n,D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = to_heads(q), to_heads(k), to_heads(v)
+    pos_full = lax.all_gather(q_positions, axis_name, axis=1, tiled=True)
+    kv_pos_full = lax.all_gather(kv_positions, axis_name, axis=1, tiled=True)
+    out = dense_attention(qg, kg, vg, causal=causal, q_positions=pos_full,
+                          kv_positions=kv_pos_full)
+    # back: [B,S,H/n,D] -> [B,S/n,H,D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

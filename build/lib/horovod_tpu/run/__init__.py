@@ -1,0 +1,19 @@
+"""hvdrun — the process launcher (reference: ``horovod/run/``).
+
+Starts one training process per slot across hosts with the
+``HOROVOD_RANK/SIZE/LOCAL_RANK/...`` env contract
+(``horovod/run/gloo_run.py:210-236``), a TCP controller endpoint for the
+native core, and an HTTP rendezvous/KV server. No MPI anywhere — TPU VMs
+don't have it; plain subprocess + ssh, like the reference's Gloo path.
+
+Entry points:
+* CLI: ``hvdrun -np 4 python train.py`` (also
+  ``python -m horovod_tpu.run``)
+* programmatic: ``horovod_tpu.run.run(fn, args=(), np=4)``
+  (reference: ``horovod.run.run()``, run.py:857-953)
+"""
+
+from horovod_tpu.run.api import run
+from horovod_tpu.run.run import main, parse_args
+
+__all__ = ["run", "main", "parse_args"]

@@ -1,0 +1,6 @@
+"""``python -m horovod_tpu.run`` == hvdrun."""
+import sys
+
+from horovod_tpu.run.run import main
+
+sys.exit(main())

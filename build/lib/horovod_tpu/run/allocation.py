@@ -1,0 +1,92 @@
+"""Host parsing and slot allocation.
+
+Rebuilds ``horovod/run/gloo_run.py:53-111`` (``_allocate``): given a host
+spec, produce one slot per process with rank / local_rank / local_size /
+cross_rank / cross_size, rank-major by host order.
+"""
+
+import dataclasses
+import re
+
+
+@dataclasses.dataclass
+class HostSlots:
+    hostname: str
+    slots: int
+
+
+@dataclasses.dataclass
+class Slot:
+    rank: int
+    hostname: str
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    size: int
+
+
+def parse_hosts(hosts_str):
+    """Parse ``"host1:4,host2:2"`` (reference ``parse_host_files`` /
+    ``-H`` handling, run.py:695-760). A bare hostname means 1 slot."""
+    out = []
+    for part in hosts_str.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            out.append(HostSlots(name, int(slots)))
+        else:
+            out.append(HostSlots(part, 1))
+    return out
+
+
+def parse_hostfile(path):
+    """Hostfile lines: ``hostname slots=N`` (mpirun-style, reference
+    run.py hostfile support)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(\S+)(?:\s+slots\s*=\s*(\d+))?$", line)
+            if not m:
+                raise ValueError(f"bad hostfile line: {line!r}")
+            out.append(HostSlots(m.group(1), int(m.group(2) or 1)))
+    return out
+
+
+def allocate(hosts, np):
+    """Assign ``np`` ranks to hosts' slots, host-major.
+
+    cross_rank/cross_size mirror the reference: for a given local_rank,
+    cross_size = number of hosts that have that local_rank filled, and
+    cross_rank = this host's index among them (gloo_run.py:84-108).
+    """
+    total = sum(h.slots for h in hosts)
+    if np > total:
+        raise ValueError(
+            f"requested {np} processes but hosts provide only {total} slots")
+    slots = []
+    rank = 0
+    per_host = []  # (hostname, ranks-on-host)
+    for h in hosts:
+        n = min(h.slots, np - rank)
+        if n <= 0:
+            break
+        per_host.append((h.hostname, list(range(rank, rank + n))))
+        rank += n
+    for host_idx, (hostname, ranks) in enumerate(per_host):
+        for lr, r in enumerate(ranks):
+            # hosts that have a process with this local_rank
+            hosts_with_lr = [i for i, (_, rr) in enumerate(per_host)
+                             if lr < len(rr)]
+            slots.append(Slot(
+                rank=r, hostname=hostname, local_rank=lr,
+                local_size=len(ranks),
+                cross_rank=hosts_with_lr.index(host_idx),
+                cross_size=len(hosts_with_lr), size=np))
+    slots.sort(key=lambda s: s.rank)
+    return slots

@@ -46,9 +46,7 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
     env = dict(extra_env or {})
     if auth_key is not None:
         env[_secret.SECRET_ENV] = _secret.encode_key(auth_key)
-    # prepend the checkout, preserving any PYTHONPATH the caller passed
-    env["PYTHONPATH"] = launcher.repo_pythonpath(
-        env if "PYTHONPATH" in env else None)
+    env["PYTHONPATH"] = launcher.repo_pythonpath()
     if use_jax_coordinator:
         from horovod_tpu.run.run import free_port
         env["HOROVOD_COORDINATOR_ADDR"] = (

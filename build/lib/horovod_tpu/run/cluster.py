@@ -1,0 +1,287 @@
+"""Programmatic cluster integration: run ``fn`` on pre-existing executors.
+
+Rebuilds the role of the reference Spark integration
+(``horovod/spark/__init__.py:101-236``): the cluster (Spark, or any
+scheduler) owns N already-placed task slots; we cannot spawn processes
+where we like, so instead each cluster task *calls us back*:
+
+1. the driver creates a per-run secret + signed KV server and ships the
+   pickled ``fn`` into it,
+2. a pluggable :class:`ClusterBackend` starts ``cluster_task`` in each
+   executor (Spark: one per partition; tests: local subprocesses),
+3. every task registers its NIC map + host hash and ring-probes its
+   successor (reusing run/discovery.py — the same protocol the reference
+   shares between ``horovod.run`` and ``horovod.spark``),
+4. the driver groups task indices by host hash, barrel-shifts so index 0
+   lands on the first host, and assigns **contiguous ranks per host**
+   (reference ``spark/__init__.py:190-203``) — that's what makes
+   hierarchical/ICI-local collectives line up with physical placement,
+5. each task receives its env assignment (rank/local_rank/cross_rank +
+   controller + rendezvous + secret), executes ``fn``, and puts the
+   result back; the driver returns results in rank order.
+
+The compute path inside ``fn`` is the ordinary horovod_tpu one (compiled
+XLA collectives on TPU, native host core for CPU tensors) — the cluster
+layer only decides *where processes already live* and *who gets which
+rank*.
+"""
+
+import json
+import os
+import pickle
+import sys
+
+from horovod_tpu.run import allocation
+from horovod_tpu.run import secret as _secret
+from horovod_tpu.run.discovery import DriverService, TaskAgent
+from horovod_tpu.run.rendezvous import (KVStoreServer, kv_get, kv_put,
+                                        kv_wait)
+
+try:
+    import cloudpickle as _pickler
+except ImportError:  # pragma: no cover
+    _pickler = pickle
+
+HOST_SALT_ENV = "HOROVOD_HOSTHASH_SALT"  # tests: fake distinct hosts
+
+
+class ClusterBackend:
+    """Something that can start ``num_tasks`` callbacks on a cluster.
+
+    ``start_tasks(num_tasks, ctx)`` must arrange for
+    ``cluster_task(index, num_tasks, ctx)`` to run in ``num_tasks``
+    separate processes (one per executor slot). ``ctx`` is a small
+    JSON-safe dict (KV address/port + hex key)."""
+
+    def start_tasks(self, num_tasks, ctx):
+        raise NotImplementedError
+
+    def alive(self):
+        """False once any task died abnormally (fails the run fast)."""
+        return True
+
+    def wait(self):
+        pass
+
+    def cancel(self):
+        pass
+
+
+class LocalProcessBackend(ClusterBackend):
+    """Fake cluster for tests and single-machine use: each 'executor' is
+    a local subprocess; ``host_salts`` simulates distinct hosts for the
+    host-hash grouping (the reference tests fake clusters the same way,
+    test/test_spark.py)."""
+
+    def __init__(self, host_salts=None, env=None):
+        self._salts = host_salts or {}
+        self._env = env or {}
+        self._procs = []
+
+    def start_tasks(self, num_tasks, ctx):
+        from horovod_tpu.run import launcher
+        for i in range(num_tasks):
+            env = dict(os.environ)
+            env.update(self._env)
+            env[_secret.SECRET_ENV] = ctx["key"]
+            if i in self._salts:
+                env[HOST_SALT_ENV] = self._salts[i]
+            env["PYTHONPATH"] = launcher.repo_pythonpath(env)
+            import subprocess
+            self._procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.cluster_task",
+                 str(i), str(num_tasks), ctx["kv_addr"],
+                 str(ctx["kv_port"])], env=env))
+
+    def alive(self):
+        return not any(p.poll() not in (None, 0) for p in self._procs)
+
+    def wait(self):
+        for p in self._procs:
+            p.wait()
+
+    def cancel(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.kill()
+
+
+class SparkBackend(ClusterBackend):
+    """Spark shim: one horovod task per Spark partition via
+    ``mapPartitionsWithIndex`` (reference ``spark/__init__.py:72-99``).
+    Requires an active SparkContext; runs the Spark job on a thread and
+    relies on Spark RPC encryption to protect the key in transit, as the
+    reference does. NOT exercised in-image (no pyspark here) — the
+    protocol underneath is covered by LocalProcessBackend tests."""
+
+    def __init__(self, spark_context=None):
+        if spark_context is None:
+            import pyspark
+            spark_context = pyspark.SparkContext._active_spark_context
+        if spark_context is None:
+            raise RuntimeError("no active SparkContext; start a PySpark "
+                               "session before horovod_tpu.spark.run()")
+        self._sc = spark_context
+        self._thread = None
+        self._error = []
+
+    def start_tasks(self, num_tasks, ctx):
+        import threading
+
+        def _mapper(index, _it):
+            yield cluster_task(index, num_tasks, ctx)
+
+        def _run():
+            try:
+                self._sc.range(0, num_tasks, numSlices=num_tasks) \
+                    .mapPartitionsWithIndex(_mapper).collect()
+            except Exception as e:  # surfaces via alive()
+                self._error.append(e)
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+
+    def alive(self):
+        return not self._error
+
+    def wait(self):
+        if self._thread:
+            self._thread.join()
+        if self._error:
+            raise self._error[0]
+
+    def cancel(self):
+        self._sc.cancelAllJobs()
+
+
+def cluster_task(index, num_tasks, ctx):
+    """Task-side protocol, runs inside a cluster executor."""
+    key = _secret.decode_key(ctx["key"])
+    os.environ[_secret.SECRET_ENV] = ctx["key"]
+    kv_addr, kv_port = ctx["kv_addr"], int(ctx["kv_port"])
+    agent = TaskAgent(index, num_tasks, kv_addr, kv_port, key,
+                      host_salt=os.environ.get(HOST_SALT_ENV))
+    try:
+        agent.register()
+        agent.run_ring_probe(timeout=ctx.get("timeout", 600))
+        agent.common_interfaces(timeout=ctx.get("timeout", 600))
+        assign = json.loads(kv_wait(kv_addr, kv_port,
+                                    f"cluster/assign/{index}",
+                                    timeout=ctx.get("timeout", 600),
+                                    auth_key=key))
+    finally:
+        agent.shutdown()
+    os.environ.update({k: str(v) for k, v in assign.items()})
+    rank = int(assign["HOROVOD_RANK"])
+    fn, args, kwargs = _pickler.loads(
+        kv_wait(kv_addr, kv_port, "runfunc/func", auth_key=key))
+    try:
+        result = fn(*args, **kwargs)
+        payload = pickle.dumps((True, result))
+    except BaseException:
+        import traceback
+        payload = pickle.dumps((False, traceback.format_exc()))
+    kv_put(kv_addr, kv_port, f"runfunc/result/{rank}", payload,
+           auth_key=key)
+    return rank
+
+
+def run_on_cluster(fn, args=(), kwargs=None, num_proc=2, backend=None,
+                   start_timeout=600, kv_host="0.0.0.0", kv_addr=None,
+                   extra_env=None):
+    """Run ``fn`` across ``num_proc`` cluster executors; returns per-rank
+    results in rank order (the reference's ``horovod.spark.run``
+    contract)."""
+    kwargs = kwargs or {}
+    backend = backend or LocalProcessBackend()
+    key = _secret.make_secret_key()
+    kv = KVStoreServer(host=kv_host, auth_key=key)
+    kv_port = kv.start()
+    if kv_addr is None:
+        from horovod_tpu.run import launcher
+        kv_addr = ("127.0.0.1" if isinstance(backend, LocalProcessBackend)
+                   else launcher.this_host_addr())
+    try:
+        kv.put("runfunc/func", _pickler.dumps((fn, args, kwargs)))
+        ctx = {"kv_addr": kv_addr, "kv_port": kv_port,
+               "key": _secret.encode_key(key), "timeout": start_timeout}
+        backend.start_tasks(num_proc, ctx)
+
+        driver = DriverService(num_proc, kv_addr, kv_port, key,
+                               liveness=backend.alive)
+        regs = driver.wait_for_registrations(timeout=start_timeout)
+        common = driver.wait_for_probes(timeout=start_timeout)
+        if not common:
+            raise RuntimeError(
+                "no common task-to-task interface across executors: "
+                + str({i: list(r["addresses"]) for i, r in regs.items()}))
+
+        # host-hash grouping; barrel-shift so index 0's host comes first
+        # (reference spark/__init__.py:190-196) → index 0 becomes rank 0
+        groups = driver.host_hash_indices(regs)
+        hashes = sorted(groups)
+        while 0 not in groups[hashes[0]]:
+            hashes = hashes[1:] + hashes[:1]
+        ranks_to_indices = [i for h in hashes for i in groups[h]]
+
+        # contiguous ranks per host: reuse the launcher's slot math with
+        # host-hash pseudo-hostnames
+        hosts = [allocation.HostSlots(h, len(groups[h])) for h in hashes]
+        slots = allocation.allocate(hosts, num_proc)
+
+        controller_idx = ranks_to_indices[0]
+        controller_ip = regs[controller_idx]["addresses"][common[0]][0][0]
+        for rank, index in enumerate(ranks_to_indices):
+            s = slots[rank]
+            # each task advertises its OWN address on the first common
+            # interface; the controller lives with rank 0
+            own_ip = regs[index]["addresses"][common[0]][0][0]
+            assign = {
+                "HOROVOD_RANK": s.rank, "HOROVOD_SIZE": s.size,
+                "HOROVOD_LOCAL_RANK": s.local_rank,
+                "HOROVOD_LOCAL_SIZE": s.local_size,
+                "HOROVOD_CROSS_RANK": s.cross_rank,
+                "HOROVOD_CROSS_SIZE": s.cross_size,
+                "HOROVOD_CONTROLLER_ADDR": controller_ip,
+                "HOROVOD_CONTROLLER_PORT": 0,
+                "HOROVOD_HOSTNAME": own_ip,
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": kv_addr,
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": kv_port,
+                "HOROVOD_COMMON_INTERFACES": ",".join(common),
+            }
+            if extra_env:
+                assign.update(extra_env)
+            kv.put(f"cluster/assign/{index}", json.dumps(assign).encode())
+
+        results = []
+        for rank in range(num_proc):
+            # same liveness discipline as the discovery phase: a dead
+            # executor fails the run now, not after start_timeout
+            import time as _time
+            deadline = _time.time() + start_timeout
+            payload = None
+            while _time.time() < deadline:
+                payload = kv_get(kv_addr, kv_port,
+                                 f"runfunc/result/{rank}", auth_key=key)
+                if payload is not None:
+                    break
+                if not backend.alive():
+                    raise RuntimeError(
+                        f"a cluster executor died before rank {rank} "
+                        f"reported its result")
+                _time.sleep(0.2)
+            if payload is None:
+                raise TimeoutError(
+                    f"rank {rank} result not published within "
+                    f"{start_timeout}s")
+            ok, value = pickle.loads(payload)
+            if not ok:
+                raise RuntimeError(f"rank {rank} raised:\n{value}")
+            results.append(value)
+        backend.wait()
+        return results
+    except BaseException:
+        backend.cancel()
+        raise
+    finally:
+        kv.stop()

@@ -1,0 +1,34 @@
+"""Entry point for one cluster executor slot (reference role:
+``horovod/spark/task/mpirun_exec_fn.py`` + ``spark/__init__.py:36-68``
+``_task_fn``): register, probe, receive rank assignment, run the shipped
+function. Launched by LocalProcessBackend; SparkBackend calls
+``cluster.cluster_task`` in-process inside the Spark partition instead.
+
+Usage: python -m horovod_tpu.run.cluster_task <index> <n> <kv_addr> <kv_port>
+The per-run key arrives via HOROVOD_SECRET_KEY.
+"""
+
+import os
+import sys
+
+from horovod_tpu.run import secret as _secret
+from horovod_tpu.run.cluster import cluster_task
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 4:
+        print("usage: cluster_task <index> <num_tasks> <kv_addr> <kv_port>",
+              file=sys.stderr)
+        return 1
+    key_hex = os.environ.get(_secret.SECRET_ENV)
+    if not key_hex:
+        print("cluster_task: HOROVOD_SECRET_KEY not set", file=sys.stderr)
+        return 1
+    ctx = {"kv_addr": argv[2], "kv_port": int(argv[3]), "key": key_hex}
+    cluster_task(int(argv[0]), int(argv[1]), ctx)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,15 +140,6 @@ class Job:
 
     def _monitor(self, rank, proc):
         rc = proc.wait()
-        # release this worker's middleman death-pipe write end (spawn());
-        # without this a long-lived driver leaks one fd per worker launch
-        death_w = getattr(proc, "_hvd_death_w", None)
-        if death_w is not None:
-            try:
-                os.close(death_w)
-            except OSError:
-                pass
-            proc._hvd_death_w = None
         if rc != 0 and not self._failed.is_set():
             with self._lock:
                 if self.first_failure is None:

@@ -1,0 +1,175 @@
+"""HTTP rendezvous / KV store server.
+
+Rebuilds ``horovod/run/http/http_server.py`` (RendezvousServer /
+KVStoreServer): an in-memory key-value store over HTTP GET/PUT/DELETE,
+scoped by path (``/scope/key``). Used by the launcher to pass pickled
+functions and collect results (``horovod.run.run()`` pattern) and
+available to external tooling as a rendezvous point. GET on a missing key
+returns 404 so clients can poll (reference http_server.py:40-60).
+
+When constructed with ``auth_key``, every request must carry a valid
+``X-HVD-Auth`` HMAC header (see run/secret.py) or it is rejected with
+403 — the HTTP realization of the reference's HMAC-signed service RPC
+(``run/common/util/network.py:61-86`` Wire, ``secret.py``). The store
+carries pickled functions, so multi-host runs must always authenticate.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from horovod_tpu.run import secret as _secret
+
+AUTH_HEADER = "X-HVD-Auth"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store = None  # class attribute set by the server
+    lock = None
+    auth_key = None
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _key(self):
+        return self.path.lstrip("/")
+
+    def _authorized(self, body=b""):
+        if self.auth_key is None:
+            return True
+        return _secret.verify(self.auth_key, self.command, self.path, body,
+                              self.headers.get(AUTH_HEADER))
+
+    def _reject(self):
+        self.send_response(403)
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._authorized():
+            return self._reject()
+        with self.lock:
+            val = self.store.get(self._key())
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    # Body cap: legitimate payloads (pickled fns, addresses, results) stay
+    # far below this. The signature covers the body, so verification
+    # can't precede the read — the cap plus the header-shape precheck
+    # bound what a garbage request can make us buffer; they don't defend
+    # against a determined flood (that needs a firewall, not a KV).
+    MAX_BODY = 64 << 20
+
+    def _header_plausible(self):
+        sig = self.headers.get(AUTH_HEADER, "")
+        return len(sig) == 64 and all(c in "0123456789abcdef" for c in sig)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        if length > self.MAX_BODY or (
+                self.auth_key is not None and not self._header_plausible()):
+            return self._reject()
+        body = self.rfile.read(length)
+        if not self._authorized(body):
+            return self._reject()
+        with self.lock:
+            self.store[self._key()] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_DELETE(self):
+        if not self._authorized():
+            return self._reject()
+        with self.lock:
+            self.store.pop(self._key(), None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVStoreServer:
+    """Threaded HTTP KV server; ``port=0`` binds an ephemeral port.
+
+    Binds loopback by default — the store carries pickled functions, so it
+    must not be reachable from the network unless the job actually spans
+    hosts (pass ``host="0.0.0.0"`` then)."""
+
+    def __init__(self, port=0, host="127.0.0.1", auth_key=None):
+        handler = type("Handler", (_Handler,),
+                       {"store": {}, "lock": threading.Lock(),
+                        "auth_key": auth_key})
+        self._handler_cls = handler
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join()
+
+    # direct access for in-process use
+    def get(self, key):
+        with self._handler_cls.lock:
+            return self._handler_cls.store.get(key)
+
+    def put(self, key, value):
+        with self._handler_cls.lock:
+            self._handler_cls.store[key] = value
+
+
+def _headers(auth_key, method, key, body=b""):
+    if auth_key is None:
+        return {}
+    return {AUTH_HEADER: _secret.sign(auth_key, method, "/" + key, body)}
+
+
+def kv_get(addr, port, key, timeout=5.0, auth_key=None):
+    import urllib.error
+    import urllib.request
+    if auth_key is None:
+        auth_key = _secret.key_from_env()
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{key}",
+        headers=_headers(auth_key, "GET", key))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def kv_put(addr, port, key, value, auth_key=None):
+    import urllib.request
+    if auth_key is None:
+        auth_key = _secret.key_from_env()
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{key}", data=value, method="PUT",
+        headers=_headers(auth_key, "PUT", key, value))
+    urllib.request.urlopen(req, timeout=5.0).read()
+
+
+def kv_wait(addr, port, key, timeout=60.0, poll=0.1, auth_key=None):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = kv_get(addr, port, key, auth_key=auth_key)
+        if v is not None:
+            return v
+        time.sleep(poll)
+    raise TimeoutError(f"key {key} not published within {timeout}s")

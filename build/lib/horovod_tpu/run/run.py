@@ -1,0 +1,210 @@
+"""The hvdrun CLI (reference: ``horovod/run/run.py`` + ``bin/horovodrun``).
+
+    hvdrun -np 4 python train.py
+    hvdrun -np 8 -H host1:4,host2:4 python train.py
+    hvdrun -np 4 --config-file cfg.yaml python train.py
+"""
+
+import argparse
+import os
+import socket
+import sys
+
+from horovod_tpu.run import allocation, config_parser, launcher
+from horovod_tpu.run import secret as _secret
+from horovod_tpu.run.discovery import DriverService
+from horovod_tpu.run.rendezvous import KVStoreServer
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu training job "
+                    "(one process per slot; no MPI required).")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   help="total number of training processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='host slots, e.g. "h1:4,h2:4" (default: localhost)')
+    p.add_argument("--hostfile", default=None,
+                   help="file with lines 'hostname slots=N'")
+    p.add_argument("-p", "--ssh-port", type=int, default=None)
+    p.add_argument("--start-timeout", type=int, default=600)
+    p.add_argument("--output-dir", default=None,
+                   help="write per-rank logs to this directory")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--config-file", default=None,
+                   help="YAML file providing any of the tuning params")
+    p.add_argument("--jax-coordinator", action="store_true",
+                   help="also start a jax.distributed coordinator so the "
+                        "workers form one global TPU mesh")
+    p.add_argument("--network-interface", "--nic", dest="nic", default=None,
+                   help="restrict control-plane traffic to this interface "
+                        "(skips automatic interface discovery)")
+    p.add_argument("--no-interface-discovery", action="store_true",
+                   help="skip the multi-host NIC discovery pre-flight")
+
+    tune = p.add_argument_group("tuning (sets HOROVOD_* env)")
+    tune.add_argument("--fusion-threshold-mb", type=int, default=None)
+    tune.add_argument("--cycle-time-ms", type=float, default=None)
+    tune.add_argument("--cache-capacity", type=int, default=None)
+    tune.add_argument("--hierarchical-allreduce", action="store_true")
+    tune.add_argument("--hierarchical-allgather", action="store_true")
+    tune.add_argument("--autotune", action="store_true")
+    tune.add_argument("--autotune-log-file", default=None)
+    tune.add_argument("--autotune-warmup-samples", type=int, default=None)
+    tune.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    tune.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                      default=None)
+    tune.add_argument("--autotune-gaussian-process-noise", type=float,
+                      default=None)
+    tune.add_argument("--timeline-filename", default=None)
+    tune.add_argument("--timeline-mark-cycles", action="store_true")
+    tune.add_argument("--no-stall-check", action="store_true")
+    tune.add_argument("--stall-warning-time-seconds", type=float,
+                      default=None)
+    tune.add_argument("--stall-shutdown-time-seconds", type=float,
+                      default=None)
+    tune.add_argument("--log-level", default=None,
+                      choices=["trace", "debug", "info", "warning",
+                               "error", "fatal"])
+
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command, e.g. python train.py")
+    return p
+
+
+def parse_args(argv=None):
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.config_file:
+        defaults = {a.dest: a.default for a in parser._actions}
+        config_parser.load_config_file(args.config_file, args, defaults)
+    return args
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _discover_interfaces(hosts, auth_key, kv_port, args, extra_env):
+    """Multi-host pre-flight (reference gloo_run driver/task services):
+    run one task_fn per host, ring-probe, and return the interface names
+    routable between every pair of adjacent hosts."""
+    launcher_ip = launcher.this_host_addr()
+    env = {_secret.SECRET_ENV: _secret.encode_key(auth_key),
+           "PYTHONPATH": extra_env.get("PYTHONPATH",
+                                       os.environ.get("PYTHONPATH", ""))}
+    procs = []
+    for idx, h in enumerate(hosts):
+        cmd = [sys.executable, "-m", "horovod_tpu.run.task_fn",
+               str(idx), str(len(hosts)), launcher_ip, str(kv_port),
+               str(args.start_timeout)]
+        procs.append(launcher.spawn(h.hostname, cmd, env,
+                                    ssh_port=args.ssh_port))
+
+    def _alive():  # a non-zero exit means ssh/startup failure
+        return not any(p.poll() not in (None, 0) for p in procs)
+
+    driver = DriverService(len(hosts), launcher_ip, kv_port, auth_key,
+                           liveness=_alive)
+    try:
+        driver.wait_for_registrations(timeout=args.start_timeout)
+        common = driver.wait_for_probes(timeout=args.start_timeout)
+        if not common:
+            raise RuntimeError(
+                "interface discovery found NO interface routable across "
+                "all hosts (interfaces must share a name on every host; "
+                "NAT'ed paths are rejected)")
+    except (TimeoutError, RuntimeError) as e:
+        for p in procs:
+            p.kill()
+        raise RuntimeError(
+            f"hvdrun: interface discovery failed: {e}\n"
+            f"Check ssh connectivity and interface naming, or pass "
+            f"--network-interface / --no-interface-discovery") from e
+    for p in procs:
+        p.wait()
+    if args.verbose:
+        print(f"hvdrun: common routable interfaces: {common}",
+              file=sys.stderr)
+    return common
+
+
+def _run(args):
+    if not args.command:
+        raise SystemExit("hvdrun: no training command given")
+    if args.hostfile:
+        hosts = allocation.parse_hostfile(args.hostfile)
+    elif args.hosts:
+        hosts = allocation.parse_hosts(args.hosts)
+    else:
+        hosts = [allocation.HostSlots("localhost", args.num_proc)]
+    slots = allocation.allocate(hosts, args.num_proc)
+
+    # the native-core coordinator lives in rank 0's process on the first
+    # host; port 0 = rank 0 binds an ephemeral port on ITS host and
+    # publishes it through the rendezvous KV (services.py) — no launcher-
+    # side probing that could collide on a remote machine
+    controller_addr = slots[0].hostname
+    if controller_addr in launcher.LOCAL_HOSTS:
+        controller_addr = "127.0.0.1"
+    controller_port = 0
+
+    # multi-host runs get a per-run HMAC key; the KV then rejects any
+    # unauthenticated request (reference secret.py + network.py Wire)
+    all_local = all(s.hostname in launcher.LOCAL_HOSTS for s in slots)
+    auth_key = None if all_local else _secret.make_secret_key()
+    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0",
+                       auth_key=auth_key)
+    rendezvous_port = kv.start()
+
+    extra_env = config_parser.args_to_env(args)
+    if auth_key is not None:
+        extra_env[_secret.SECRET_ENV] = _secret.encode_key(auth_key)
+    if args.nic:
+        extra_env["HOROVOD_COMMON_INTERFACES"] = args.nic
+    elif not all_local and not args.no_interface_discovery:
+        common = _discover_interfaces(hosts, auth_key, rendezvous_port,
+                                      args, extra_env)
+        if common:
+            extra_env["HOROVOD_COMMON_INTERFACES"] = ",".join(common)
+    if args.jax_coordinator:
+        # probing is only sound for a local rank 0; remote gets a random
+        # high port (collision unlikely, bind failure is loud)
+        import random
+        jport = (free_port() if controller_addr == "127.0.0.1"
+                 else random.randint(23000, 43000))
+        extra_env["HOROVOD_COORDINATOR_ADDR"] = f"{controller_addr}:{jport}"
+
+    if args.verbose:
+        print(f"hvdrun: launching {args.num_proc} processes: "
+              f"{[ (s.rank, s.hostname, s.local_rank) for s in slots ]}",
+              file=sys.stderr)
+    job = launcher.launch(slots, args.command, controller_addr,
+                          controller_port, rendezvous_port=rendezvous_port,
+                          extra_env=extra_env, ssh_port=args.ssh_port,
+                          output_dir=args.output_dir)
+    try:
+        job.wait()
+    finally:
+        kv.stop()
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    try:
+        _run(args)
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

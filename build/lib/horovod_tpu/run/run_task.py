@@ -1,0 +1,36 @@
+"""Worker entry for the programmatic ``run(fn)`` API (reference:
+``horovod/run/run_task.py`` + ``task_fn``): fetch the pickled function
+from the launcher's KV server, execute under the env contract, publish the
+result."""
+
+import os
+import pickle
+import sys
+import traceback
+
+from horovod_tpu.run.rendezvous import kv_put, kv_wait
+
+try:
+    import cloudpickle as _pickler  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass  # plain pickle.loads handles cloudpickle payloads it can import
+
+
+def main():
+    addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"])
+    rank = int(os.environ["HOROVOD_RANK"])
+    fn, args, kwargs = pickle.loads(
+        kv_wait(addr, port, "runfunc/func", timeout=60))
+    try:
+        value = fn(*args, **kwargs)
+        payload = pickle.dumps((True, value))
+    except BaseException:
+        payload = pickle.dumps((False, traceback.format_exc()))
+        kv_put(addr, port, f"runfunc/result/{rank}", payload)
+        sys.exit(1)
+    kv_put(addr, port, f"runfunc/result/{rank}", payload)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Middleman process: no orphans survive the launcher.
+
+Rebuilds ``horovod/run/common/util/safe_shell_exec.py``: the reference
+forks a *middleman* between launcher and training process so that when
+the launcher dies — SIGKILL, machine reboot of the launch host, dropped
+ssh — every descendant of the training command is terminated instead of
+orphaning onto the machine. Detection rides a pipe: the launcher holds
+the write end; when it exits for any reason the kernel closes it, the
+middleman's blocking read returns EOF, and the middleman reaps the tree
+(graceful SIGTERM, then SIGKILL after a grace period).
+
+Differences from the reference realization: the middleman here is an
+exec'd module (works over ssh, where fork() can't cross the wire), the
+executor runs in its own session so one ``killpg`` catches the whole
+group, and escapees that called setsid() themselves are found by walking
+``/proc`` (the image has no psutil).
+
+Modes:
+
+* ``python -m horovod_tpu.run.safe_exec <death_fd> -- cmd...`` — local:
+  ``death_fd`` is the inherited read end of the launcher's pipe.
+* ``python -m horovod_tpu.run.safe_exec --watch-stdin -- cmd...`` —
+  remote: EOF on stdin (the ssh connection dying) triggers the reap;
+  composes with the secret-over-stdin prefix, which consumes only the
+  first line.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+GRACEFUL_TERMINATION_TIME_S = 5.0
+
+
+def _children_of(pid_set):
+    """Direct children of any pid in ``pid_set``, via /proc (PPid)."""
+    kids = set()
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as f:
+                stat = f.read()
+            # field 4 (after the parenthesized comm, which may contain
+            # spaces) is ppid
+            ppid = int(stat.rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            continue
+        if ppid in pid_set:
+            kids.add(int(entry))
+    return kids
+
+
+def descendants(pid):
+    """All live descendants of ``pid``, recursively (psutil-free)."""
+    seen = {pid}
+    frontier = {pid}
+    while frontier:
+        frontier = _children_of(frontier) - seen
+        seen |= frontier
+    seen.discard(pid)
+    return seen
+
+
+def terminate_tree(proc, grace=GRACEFUL_TERMINATION_TIME_S):
+    """SIGTERM the executor's whole tree, wait, then SIGKILL whatever is
+    left — including processes that re-setsid'd out of the group
+    (reference ``terminate_executor_shell_and_children``)."""
+    if proc.poll() is not None and not descendants(proc.pid):
+        return
+    tree = descendants(proc.pid) | {proc.pid}
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)  # executor leads its session
+    except ProcessLookupError:
+        pass
+    for p in tree:
+        try:
+            os.kill(p, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    deadline = time.time() + grace
+    while time.time() < deadline:
+        if proc.poll() is not None and not descendants(proc.pid):
+            break
+        time.sleep(0.1)
+    tree = descendants(proc.pid) | {proc.pid}
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    for p in tree:
+        try:
+            os.kill(p, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
+def run_middleman(command, death_fd=None, watch_stdin=False, env=None):
+    """Spawn ``command`` in its own session and guard it; returns the
+    command's exit code (negative signal → 128+sig, shell style)."""
+    proc = subprocess.Popen(command, env=env, start_new_session=True)
+    fired = threading.Event()
+
+    def _reap():
+        if not fired.is_set():
+            fired.set()
+            terminate_tree(proc)
+
+    def _on_signal(signum, frame):
+        threading.Thread(target=_reap, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    def _watch(fd):
+        try:
+            while os.read(fd, 1):
+                pass  # discard until EOF
+        except OSError:
+            pass
+        _reap()  # launcher is gone
+
+    if death_fd is not None:
+        threading.Thread(target=_watch, args=(death_fd,),
+                         daemon=True).start()
+    if watch_stdin:
+        threading.Thread(target=_watch, args=(sys.stdin.fileno(),),
+                         daemon=True).start()
+
+    rc = proc.wait()
+    return 128 - rc if rc < 0 else rc
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if "--" not in argv:
+        print("usage: safe_exec (<death_fd>|--watch-stdin) -- cmd...",
+              file=sys.stderr)
+        return 2
+    split = argv.index("--")
+    opts, command = argv[:split], argv[split + 1:]
+    death_fd = None
+    watch_stdin = False
+    for o in opts:
+        if o == "--watch-stdin":
+            watch_stdin = True
+        else:
+            death_fd = int(o)
+    return run_middleman(command, death_fd=death_fd,
+                         watch_stdin=watch_stdin)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Per-run secret keys and HMAC request signing.
+
+Rebuilds the role of ``horovod/run/common/util/secret.py:1-36`` (per-run
+32-byte key, HMAC-SHA256 digests, constant-time comparison) for this
+framework's HTTP control plane.  Where the reference frames raw-TCP
+messages as ``digest || len || cloudpickle``, we sign HTTP requests with
+an ``X-HVD-Auth`` header over ``method \\n path \\n body`` — same
+guarantee (no unauthenticated writes reach the run's control services),
+realized idiomatically for the HTTP KV/rendezvous plane.
+
+The key travels to workers the same way the reference distributes it: an
+environment variable (reference ``_HOROVOD_SECRET_KEY``), hex-encoded.
+"""
+
+import hashlib
+import hmac
+import os
+
+SECRET_LENGTH = 32  # bytes
+SECRET_ENV = "HOROVOD_SECRET_KEY"
+
+
+def make_secret_key():
+    """A fresh per-run key (reference secret.py:27-28)."""
+    return os.urandom(SECRET_LENGTH)
+
+
+def encode_key(key):
+    return key.hex()
+
+
+def decode_key(text):
+    return bytes.fromhex(text)
+
+
+def key_from_env(env=None):
+    """The run's key from the environment, or None when the run is
+    unauthenticated (single-host loopback jobs)."""
+    val = (env or os.environ).get(SECRET_ENV)
+    return decode_key(val) if val else None
+
+
+def sign(key, method, path, body=b""):
+    """Hex HMAC-SHA256 over the request triple."""
+    msg = method.encode() + b"\n" + path.encode() + b"\n" + body
+    return hmac.new(key, msg, hashlib.sha256).hexdigest()
+
+
+def verify(key, method, path, body, digest_hex):
+    """Constant-time check (reference secret.py:35-36). Compares as
+    bytes: compare_digest on str raises for non-ASCII input, which a
+    hostile header could otherwise use to crash the handler thread."""
+    if not digest_hex:
+        return False
+    expected = sign(key, method, path, body)
+    return hmac.compare_digest(expected.encode(), digest_hex.encode())

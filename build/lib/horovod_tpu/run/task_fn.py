@@ -1,0 +1,50 @@
+"""Per-host discovery task, launched by hvdrun before the training job.
+
+The reference launches ``horovod/run/task_fn.py:1-67`` on every host via
+ssh: it registers the host's candidate addresses with the driver, ring-
+probes its successor with interface matching, and exits.  This module is
+the same protocol over the signed KV (run/discovery.py); hvdrun runs one
+instance per *host* and then feeds the elected common interfaces into
+every worker's environment.
+
+Usage (spawned by run.py, not by hand)::
+
+    python -m horovod_tpu.run.task_fn <index> <num_hosts> <kv_addr> <kv_port>
+
+The per-run HMAC key arrives via the environment (HOROVOD_SECRET_KEY),
+like the reference's ``_HOROVOD_SECRET_KEY``.
+"""
+
+import sys
+
+from horovod_tpu.run import secret as _secret
+from horovod_tpu.run.discovery import TaskAgent
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) not in (4, 5):
+        print("usage: task_fn <index> <num_hosts> <kv_addr> <kv_port> "
+              "[timeout_s]", file=sys.stderr)
+        return 1
+    index, num_hosts = int(argv[0]), int(argv[1])
+    kv_addr, kv_port = argv[2], int(argv[3])
+    timeout = float(argv[4]) if len(argv) == 5 else 600.0
+    key = _secret.key_from_env()
+    if key is None:
+        print("task_fn: HOROVOD_SECRET_KEY not set", file=sys.stderr)
+        return 1
+    agent = TaskAgent(index, num_hosts, kv_addr, kv_port, key)
+    try:
+        agent.register()
+        agent.run_ring_probe(timeout=timeout)
+        # block until the driver publishes the verdict so the ping server
+        # stays up for any still-probing predecessor
+        agent.common_interfaces(timeout=timeout)
+    finally:
+        agent.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Host-side runtime: controller, rendezvous, timeline, stall inspector.
+
+The TPU analogue of the reference's C++ core (``horovod/common/``): on TPU
+the *data plane* is compiled by XLA, so what remains host-side is the
+control plane — process rendezvous and coordination (TCP, no MPI), the
+name-negotiated readiness protocol for the eager op path, response caching,
+stall detection, the Chrome-trace timeline, and the autotuner. The hot
+pieces are implemented natively in C++ (``horovod_tpu/runtime/core/``) and
+bound via ctypes.
+"""

@@ -1,0 +1,98 @@
+"""Lifecycle of host-side services attached at ``init()`` time.
+
+Reference equivalent: the service wiring in ``BackgroundThreadLoop``
+(``horovod/common/operations.cc:328-528``) — timeline setup at
+``operations.cc:388-395``, stall inspector, controller initialization.
+
+Multi-process jobs start the **native core** (``cxx/`` via
+``horovod_tpu._core``): its background thread owns the TCP control plane
+(negotiation, Join, barrier) and the host CPU data plane (ring
+collectives). Single-process jobs skip it entirely — the compiled XLA
+path needs no host services.
+"""
+
+import logging
+import os
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def _resolve_controller_port(cfg):
+    """Port 0 contract: rank 0 picks a free port on ITS host and publishes
+    it through the launcher's rendezvous KV; everyone else polls for it.
+    Avoids the launcher probing ports on a machine it doesn't run on."""
+    import socket
+
+    from horovod_tpu.run.rendezvous import kv_put, kv_wait
+    if not cfg.rendezvous_addr:
+        raise RuntimeError(
+            "HOROVOD_CONTROLLER_PORT=0 requires the hvdrun rendezvous "
+            "server (HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT)")
+    if cfg.rank == 0:
+        s = socket.socket()
+        s.bind(("0.0.0.0", 0))
+        port = s.getsockname()[1]
+        s.close()
+        kv_put(cfg.rendezvous_addr, cfg.rendezvous_port,
+               "controller/port", str(port).encode())
+        return port
+    return int(kv_wait(cfg.rendezvous_addr, cfg.rendezvous_port,
+                       "controller/port", timeout=120).decode())
+
+
+def start(state):
+    cfg = state.config
+    native_core = bool(cfg.controller_addr and cfg.size > 1)
+    # the native core's C++ timeline owns HOROVOD_TIMELINE in multi-process
+    # jobs; the Python timeline covers the single-process compiled path
+    if cfg.timeline and cfg.rank == 0 and not native_core:
+        from horovod_tpu.utils.timeline import Timeline
+        state.timeline = Timeline(cfg.timeline,
+                                  mark_cycles=cfg.timeline_mark_cycles)
+        logger.info("timeline enabled -> %s", cfg.timeline)
+    if native_core:
+        from horovod_tpu import _core
+        advertise = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+        if advertise in ("localhost",):
+            advertise = "127.0.0.1"
+        # hvdrun's NIC-discovery pre-flight (run/discovery.py) elects the
+        # interfaces routable across all hosts; advertise this host's
+        # address on the first elected interface we own, so the peer mesh
+        # never hands out a NAT'ed/bridge address (reference: gloo
+        # iface selection from the driver/task services)
+        common = os.environ.get("HOROVOD_COMMON_INTERFACES")
+        if common and advertise != "127.0.0.1":
+            from horovod_tpu.run.discovery import local_interfaces
+            mine = local_interfaces()
+            for intf in common.split(","):
+                if mine.get(intf):
+                    advertise = mine[intf][0][0]
+                    break
+        controller_port = cfg.controller_port
+        if controller_port == 0:
+            controller_port = _resolve_controller_port(cfg)
+        _core.init(rank=cfg.rank, size=cfg.size,
+                   coord_host=cfg.controller_addr,
+                   coord_port=controller_port,
+                   advertise_host=advertise)
+        state.controller = _core
+        logger.info("native core started (controller %s:%d)",
+                    cfg.controller_addr, cfg.controller_port)
+    if not cfg.stall_check_disable and state.controller is not None:
+        from horovod_tpu.runtime.stall import StallInspector
+        state.stall_inspector = StallInspector(
+            warning_time=cfg.stall_warning_time,
+            shutdown_time=cfg.stall_shutdown_time)
+        state.stall_inspector.start()
+
+
+def stop(state):
+    if state.stall_inspector is not None:
+        state.stall_inspector.stop()
+        state.stall_inspector = None
+    if state.controller is not None:
+        state.controller.shutdown()
+        state.controller = None
+    if state.timeline is not None:
+        state.timeline.close()
+        state.timeline = None

@@ -1,0 +1,67 @@
+"""Stall inspector: warn when ranks stop making progress together.
+
+Reference: ``horovod/common/stall_inspector.cc`` — coordinator-side watchdog
+that warns when a tensor has been submitted by some ranks but is missing on
+others for >60 s (``stall_inspector.h:30-70``), with optional job shutdown
+after ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``.
+
+TPU version: the compiled data plane cannot stall *per-tensor* (one fused
+program either runs or not), so the unit of progress is the **step**. Each
+worker reports a heartbeat (step counter) through the controller; the
+inspector warns when this worker's step outruns or lags the slowest/fastest
+reported step for longer than the warning threshold, and can raise to abort
+the job after the shutdown threshold.
+"""
+
+import logging
+import threading
+import time
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class StallInspector:
+    def __init__(self, warning_time=60.0, shutdown_time=0.0,
+                 heartbeat_fn=None, check_interval=5.0):
+        self._warning_time = warning_time
+        self._shutdown_time = shutdown_time
+        self._heartbeat_fn = heartbeat_fn  # () -> dict rank->last_step_time
+        self._check_interval = check_interval
+        self._last_progress = time.monotonic()
+        self._stop_event = threading.Event()
+        self._thread = None
+        self._warned = False
+        self.shutdown_requested = False
+
+    def record_progress(self):
+        """Call once per completed step (the analogue of a tensor being
+        submitted by this rank)."""
+        self._last_progress = time.monotonic()
+        self._warned = False
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hvd_tpu_stall", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop_event.wait(self._check_interval):
+            idle = time.monotonic() - self._last_progress
+            if idle > self._warning_time and not self._warned:
+                logger.warning(
+                    "One or more ranks stalled for %.0f s (no training-step "
+                    "progress). Check that all ranks are submitting steps.",
+                    idle)
+                self._warned = True
+            if self._shutdown_time > 0 and idle > self._shutdown_time:
+                logger.error(
+                    "Stall exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS "
+                    "(%.0f s); requesting shutdown.", self._shutdown_time)
+                self.shutdown_requested = True
+                break
+
+    def stop(self):
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -109,11 +109,9 @@ def allreduce(tensor, average=None, name=None, op=None,
     if op is None:
         op = Average if (average is None or average) else Sum
     if isinstance(tensor, tf.IndexedSlices):
-        if op not in (Sum, Average):
-            # the gathered (values, indices) pairs ARE the sum/average of
-            # the represented tensor; no other reduction holds
+        if op == Adasum:
             raise NotImplementedError(
-                f"{op} does not support sparse tensors; pass "
+                "Adasum does not support sparse tensors; pass "
                 "sparse_as_dense=True to DistributedOptimizer")
         # distinct wire names per component: one tensor name must map to
         # one (shape, dtype) stream or the response cache re-negotiates
@@ -206,11 +204,7 @@ class DistributedOptimizer:
     def __init__(self, optimizer, name=None, op=Average,
                  compression=Compression.none, sparse_as_dense=False):
         self._optimizer = optimizer
-        # per-instance wire-name prefix: two unnamed wrappers around the
-        # same optimizer class must not negotiate under identical tensor
-        # names (creation order is assumed rank-consistent, as in torch)
-        self._name = name or _auto_name(
-            "opt", None) + f".Distributed{type(optimizer).__name__}"
+        self._name = name or f"Distributed{type(optimizer).__name__}"
         self._op = op
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
@@ -262,7 +256,6 @@ class DistributedGradientTape:
         self._op = op
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
-        self._name = _auto_name("tape", None)  # per-instance, see above
 
     def __enter__(self):
         self._tape.__enter__()
@@ -279,4 +272,4 @@ class DistributedGradientTape:
         if size() <= 1:
             return grads
         return _allreduce_grads(grads, self._op, self._compression,
-                                self._sparse_as_dense, self._name)
+                                self._sparse_as_dense, "tape")

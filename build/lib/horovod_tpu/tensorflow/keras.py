@@ -1,0 +1,63 @@
+"""Keras-on-TF helpers (reference: ``horovod/keras/__init__.py``).
+
+``DistributedOptimizer`` wraps a keras optimizer so its gradients are
+allreduced before the update (reference ``_impl.create_distributed_
+optimizer``, ``horovod/_keras/__init__.py:23-55``), and ``load_model``
+restores a saved model while transparently re-wrapping whatever
+optimizer it was trained with (reference ``keras/__init__.py:117-150``)
+— that is what makes rank-0-restore + broadcast resume work for Keras
+models, since the optimizer slot weights come back with the model.
+"""
+
+import tensorflow as tf
+
+from horovod_tpu.ops.reduction import Average
+from horovod_tpu.tensorflow import Compression, allreduce, size
+
+
+def DistributedOptimizer(optimizer, name=None, op=Average,
+                         compression=Compression.none):
+    """Wrap a keras optimizer: ``get_gradients`` (and TF1-style
+    ``compute_gradients`` when present) allreduce before returning."""
+    cls = type(optimizer)
+
+    class _Distributed(cls):
+        _hvd_wrapped = cls
+
+        def get_gradients(self, loss, params):
+            grads = super().get_gradients(loss, params)
+            if size() <= 1:
+                return grads
+            return [None if g is None else
+                    allreduce(g, op=op, compression=compression,
+                              name=f"k.{i}")
+                    for i, g in enumerate(grads)]
+
+    _Distributed.__name__ = name or f"Distributed{cls.__name__}"
+    # from_config deserializes nested objects (e.g. LearningRateSchedule
+    # dicts) that a raw **config constructor call would pass through as
+    # garbage (reference _keras/__init__.py uses from_config for this)
+    if hasattr(_Distributed, "from_config"):
+        return _Distributed.from_config(optimizer.get_config())
+    return _Distributed(**optimizer.get_config())
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """``tf.keras.models.load_model`` with every known optimizer class
+    mapped to a factory that re-wraps it in DistributedOptimizer
+    (reference ``keras/__init__.py:146-150`` ``wrap_optimizer``)."""
+    def wrap(cls):
+        return lambda **kw: DistributedOptimizer(cls(**kw),
+                                                 compression=compression)
+
+    objects = {}
+    opt_mod = tf.keras.optimizers
+    for attr in dir(opt_mod):
+        cls = getattr(opt_mod, attr)
+        if isinstance(cls, type):
+            objects[attr] = wrap(cls)
+    for cls in (custom_optimizers or []):
+        objects[cls.__name__] = wrap(cls)
+    objects.update(custom_objects or {})
+    return tf.keras.models.load_model(filepath, custom_objects=objects)

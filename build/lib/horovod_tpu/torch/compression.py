@@ -1,0 +1,31 @@
+"""Gradient wire compression for the torch adapter (reference:
+``horovod/torch/compression.py``): cast to fp16 before the collective,
+cast back after."""
+
+
+class NoneCompressor:
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.half(), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.to(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression.none`` / ``.fp16``."""
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -1,0 +1,170 @@
+"""Handle-based collective ops on torch tensors.
+
+Rebuilds ``horovod/torch/mpi_ops.py`` (allreduce_async/_, allgather_async,
+broadcast_async/_, alltoall, poll, synchronize) over the native core
+(``horovod_tpu._core`` — the TCP ring data plane; reference role:
+``mpi_ops_v2.cc`` enqueueing into the C++ background thread). Tensors are
+host CPU tensors here — TPU-resident training uses the JAX path.
+
+Async semantics match the reference: ``*_async`` returns a handle
+immediately, the background thread negotiates + executes, ``synchronize``
+blocks and produces the result. In-place variants write back into the
+input tensor.
+"""
+
+import numpy as np
+import torch
+
+from horovod_tpu import _core
+from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
+
+_name_counter = {}
+
+
+def _ensure_core():
+    """The torch ops need the native core. Multi-process jobs start it in
+    ``hvd.init()`` (launcher env contract); single-process gets a local
+    size-1 core on first use. Calling without ``init()`` raises, like the
+    reference (``check_initialized``)."""
+    from horovod_tpu import basics
+    if not basics.is_initialized():
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call hvd.init()")
+    if not _core.is_initialized():
+        _core.init(rank=0, size=1)
+
+
+def _auto_name(kind, name):
+    if name is not None:
+        return name
+    n = _name_counter.get(kind, 0)
+    _name_counter[kind] = n + 1
+    return f"{kind}.noname.{n}"
+
+
+class TorchHandle:
+    """Wraps a core handle; optionally writes the result back in place."""
+
+    def __init__(self, core_handle, out_tensor=None, postprocess=None):
+        self._h = core_handle
+        self._out = out_tensor
+        self._post = postprocess
+
+    def poll(self):
+        return self._h.poll()
+
+    def synchronize(self):
+        arr = self._h.wait()
+        t = torch.from_numpy(np.array(arr))
+        if self._post is not None:
+            t = self._post(t)
+        if self._out is not None:
+            if self._out.shape != t.shape:
+                self._out.resize_(t.shape)
+            self._out.copy_(t)
+            return self._out
+        return t
+
+
+def _to_numpy(tensor):
+    _ensure_core()
+    if tensor.device.type != "cpu":
+        raise ValueError(
+            "the torch adapter operates on CPU tensors; TPU-resident "
+            "training uses the JAX path (horovod_tpu.ops.collective)")
+    return tensor.detach().contiguous().numpy()
+
+
+def allreduce_async(tensor, average=True, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0):
+    op = op or (Average if average else Sum)
+    h = _core.allreduce_async(_to_numpy(tensor), _auto_name("allreduce",
+                                                            name),
+                              op=op, prescale=prescale_factor,
+                              postscale=postscale_factor)
+    return TorchHandle(h)
+
+
+def allreduce(tensor, average=True, name=None, op=None, compression=None,
+              **kw):
+    from horovod_tpu.torch.compression import Compression
+    compression = compression or Compression.none
+    wire, ctx = compression.compress(tensor)
+    handle = allreduce_async(wire, average=average, name=name, op=op, **kw)
+    out = handle.synchronize()
+    return compression.decompress(out, ctx)
+
+
+def allreduce_async_(tensor, average=True, name=None, op=None, **kw):
+    """In-place: the result is written back into ``tensor``."""
+    op = op or (Average if average else Sum)
+    h = _core.allreduce_async(_to_numpy(tensor),
+                              _auto_name("allreduce", name), op=op, **kw)
+    return TorchHandle(h, out_tensor=tensor)
+
+
+def allreduce_(tensor, average=True, name=None, op=None, **kw):
+    return allreduce_async_(tensor, average=average, name=name, op=op,
+                            **kw).synchronize()
+
+
+def allgather_async(tensor, name=None):
+    h = _core.allgather_async(_to_numpy(tensor),
+                              _auto_name("allgather", name))
+    return TorchHandle(h)
+
+
+def allgather(tensor, name=None):
+    return allgather_async(tensor, name).synchronize()
+
+
+def broadcast_async(tensor, root_rank, name=None):
+    h = _core.broadcast_async(_to_numpy(tensor),
+                              _auto_name("broadcast", name),
+                              root_rank=root_rank)
+    return TorchHandle(h)
+
+
+def broadcast(tensor, root_rank, name=None):
+    return broadcast_async(tensor, root_rank, name).synchronize()
+
+
+def broadcast_async_(tensor, root_rank, name=None):
+    h = _core.broadcast_async(_to_numpy(tensor),
+                              _auto_name("broadcast", name),
+                              root_rank=root_rank)
+    return TorchHandle(h, out_tensor=tensor)
+
+
+def broadcast_(tensor, root_rank, name=None):
+    return broadcast_async_(tensor, root_rank, name).synchronize()
+
+
+def alltoall(tensor, name=None):
+    h = _core.alltoall_async(_to_numpy(tensor), _auto_name("alltoall",
+                                                           name))
+    return TorchHandle(h).synchronize()
+
+
+def poll(handle):
+    return handle.poll()
+
+
+def synchronize(handle):
+    return handle.synchronize()
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Broadcast an arbitrary picklable object (two-phase: length then
+    padded payload — shapes must agree across ranks)."""
+    import pickle
+    name = _auto_name("bcast_object", name)
+    payload = pickle.dumps(obj)
+    n = torch.tensor([len(payload)], dtype=torch.int64)
+    n = broadcast(n, root_rank, name=f"{name}.len")
+    buf = torch.zeros(int(n.item()), dtype=torch.uint8)
+    if len(payload) == int(n.item()):
+        buf[:] = torch.from_numpy(
+            np.frombuffer(payload, dtype=np.uint8).copy())
+    buf = broadcast(buf, root_rank, name=f"{name}.data")
+    return pickle.loads(buf.numpy().tobytes())

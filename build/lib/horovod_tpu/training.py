@@ -148,7 +148,6 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
         return jitted(place_repl(state), place_data(inputs),
                       place_data(labels))
 
-    step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
     return step
 
 

@@ -1,0 +1,96 @@
+"""Chrome-tracing-format timeline (``chrome://tracing`` / Perfetto).
+
+Reference: ``horovod/common/timeline.cc`` — a dedicated writer thread fed by
+a lockfree queue records per-tensor phases NEGOTIATING → TOP_LEVEL →
+ACTIVITY (``timeline.h:47-77``), enabled by ``HOROVOD_TIMELINE=<file>`` on
+the coordinator (``operations.cc:388-395``).
+
+TPU version: the same event vocabulary for host-side phases (negotiation,
+enqueue, fusion planning, step dispatch); device-side time lives in the XLA
+profiler, so ``instant`` markers are emitted around dispatch to let users
+line the two traces up. Events are queued to a writer thread so the hot
+path never blocks on file IO (same design as the reference).
+"""
+
+import json
+import queue
+import threading
+import time
+
+
+class Timeline:
+    NEGOTIATING = "NEGOTIATING"
+    TOP_LEVEL = "TOP_LEVEL"
+
+    def __init__(self, path, mark_cycles=False):
+        self._path = path
+        self._mark_cycles = mark_cycles
+        self._queue = queue.Queue()
+        self._start = time.perf_counter()
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._closed = False
+        self._thread = threading.Thread(target=self._writer_loop,
+                                        name="hvd_tpu_timeline", daemon=True)
+        self._thread.start()
+
+    # -- event API (mirrors timeline.h naming) ------------------------------
+    def _ts_us(self):
+        return int((time.perf_counter() - self._start) * 1e6)
+
+    def _emit(self, ev):
+        if not self._closed:
+            self._queue.put(ev)
+
+    def negotiate_start(self, tensor_name, request_type):
+        self._emit({"name": request_type, "cat": self.NEGOTIATING, "ph": "B",
+                    "ts": self._ts_us(), "pid": 0, "tid": tensor_name})
+
+    def negotiate_rank_ready(self, tensor_name, rank):
+        self._emit({"name": f"rank_{rank}_ready", "ph": "i",
+                    "ts": self._ts_us(), "pid": 0, "tid": tensor_name,
+                    "s": "t"})
+
+    def negotiate_end(self, tensor_name):
+        self._emit({"name": "", "ph": "E", "ts": self._ts_us(), "pid": 0,
+                    "tid": tensor_name})
+
+    def start_activity(self, tensor_name, activity):
+        self._emit({"name": activity, "ph": "B", "ts": self._ts_us(),
+                    "pid": 0, "tid": tensor_name})
+
+    def end_activity(self, tensor_name):
+        self._emit({"name": "", "ph": "E", "ts": self._ts_us(), "pid": 0,
+                    "tid": tensor_name})
+
+    def instant(self, name, args=None):
+        ev = {"name": name, "ph": "i", "ts": self._ts_us(), "pid": 0,
+              "tid": "marker", "s": "g"}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def mark_cycle(self, n):
+        if self._mark_cycles:
+            self.instant(f"CYCLE_{n}")
+
+    # -- writer thread -------------------------------------------------------
+    def _writer_loop(self):
+        first = True
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                break
+            if not first:
+                self._file.write(",\n")
+            json.dump(ev, self._file)
+            first = False
+        self._file.write("\n]\n")
+        self._file.close()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join(timeout=5)

@@ -5,6 +5,7 @@ into the environment). Mirrors the slivers of API the adapter touches:
 ``optimizer.Optimizer``, and a gluon ``Trainer``/``Parameter`` pair.
 """
 
+import importlib.machinery
 import sys
 import types
 
@@ -119,8 +120,10 @@ def install():
     mx.gluon = types.ModuleType("mxnet.gluon")
     mx.gluon.Trainer = Trainer
     mx.gluon.Parameter = Parameter
-    sys.modules["mxnet"] = mx
-    sys.modules["mxnet.nd"] = mx.nd
-    sys.modules["mxnet.optimizer"] = mx.optimizer
-    sys.modules["mxnet.gluon"] = mx.gluon
+    mods = {"mxnet": mx, "mxnet.nd": mx.nd,
+            "mxnet.optimizer": mx.optimizer, "mxnet.gluon": mx.gluon}
+    for name, mod in mods.items():
+        # None __spec__ breaks importlib.util.find_spec probes elsewhere
+        mod.__spec__ = importlib.machinery.ModuleSpec(name, None)
+        sys.modules[name] = mod
     return mx

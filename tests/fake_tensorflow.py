@@ -9,6 +9,7 @@ with ``custom_objects`` resolution (what hvd's load_model hooks into).
 """
 
 import pickle
+import importlib.machinery
 import sys
 import types
 
@@ -190,9 +191,13 @@ def install():
     tf.keras.models.save_model = _save_model
     tf.keras.models.load_model = _load_model
     tf.keras.Model = _KerasModel
-    sys.modules["tensorflow"] = tf
-    sys.modules["tensorflow.train"] = tf.train
-    sys.modules["tensorflow.keras"] = tf.keras
-    sys.modules["tensorflow.keras.optimizers"] = tf.keras.optimizers
-    sys.modules["tensorflow.keras.models"] = tf.keras.models
+    mods = {"tensorflow": tf, "tensorflow.train": tf.train,
+            "tensorflow.keras": tf.keras,
+            "tensorflow.keras.optimizers": tf.keras.optimizers,
+            "tensorflow.keras.models": tf.keras.models}
+    for name, mod in mods.items():
+        # a None __spec__ makes importlib.util.find_spec raise for any
+        # OTHER library probing for tensorflow (torch does)
+        mod.__spec__ = importlib.machinery.ModuleSpec(name, None)
+        sys.modules[name] = mod
     return tf

@@ -32,11 +32,12 @@ def mx():
 
 
 def _mx_env():
-    """Workers must import the fake before horovod_tpu.mxnet."""
+    """Workers must import the fake before horovod_tpu.mxnet — passed
+    via extra_env, never by mutating this process's environ."""
     existing = os.environ.get("PYTHONPATH", "")
-    os.environ["PYTHONPATH"] = os.pathsep.join(
-        [p for p in [TESTS_DIR, existing] if p])
-    return {"JAX_PLATFORMS": "cpu"}
+    return {"JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": os.pathsep.join(
+                [p for p in [TESTS_DIR, existing] if p])}
 
 
 # ---- single-process semantics ------------------------------------------

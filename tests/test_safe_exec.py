@@ -1,0 +1,143 @@
+"""Orphan-reaping middleman (reference safe_shell_exec.py): launcher
+death — even SIGKILL — must terminate the whole training process tree,
+including grandchildren that re-setsid'd."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from horovod_tpu.run import launcher
+from horovod_tpu.run.safe_exec import descendants
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def _wait_dead(pid, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not _alive(pid):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_exit_code_propagates():
+    # stdin must stay open: EOF on it IS the launcher-death signal
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run.safe_exec",
+         "--watch-stdin", "--", sys.executable, "-c", "raise SystemExit(7)"],
+        env=_env(), stdin=subprocess.PIPE)
+    assert proc.wait(timeout=60) == 7
+
+
+def test_descendants_walks_proc():
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import subprocess,sys,time;"
+         "p=subprocess.Popen([sys.executable,'-c','import time;time.sleep(60)']);"
+         "time.sleep(60)"])
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if descendants(proc.pid):
+                break
+            time.sleep(0.1)
+        kids = descendants(proc.pid)
+        assert len(kids) >= 1
+    finally:
+        for p in descendants(proc.pid):
+            os.kill(p, signal.SIGKILL)
+        proc.kill()
+        proc.wait()
+
+
+def _spawn_guarded_tree(tmp_path, kill_parent_how):
+    """Start parent -> middleman -> worker -> grandchild(setsid); return
+    (parent Popen, grandchild pid)."""
+    pidfile = str(tmp_path / "gc.pid")
+    worker = textwrap.dedent(f"""
+        import os, subprocess, sys, time
+        gc = subprocess.Popen([sys.executable, '-c',
+                               'import time; time.sleep(300)'],
+                              start_new_session=True)  # escapes the group
+        open({pidfile!r}, 'w').write(str(gc.pid))
+        time.sleep(300)
+    """)
+    parent = textwrap.dedent(f"""
+        import os, subprocess, sys, time
+        r, w = os.pipe()
+        mid = subprocess.Popen(
+            [sys.executable, '-m', 'horovod_tpu.run.safe_exec', str(r),
+             '--', sys.executable, '-c', {worker!r}],
+            pass_fds=(r,))
+        os.close(r)
+        time.sleep(300)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", parent], env=_env())
+    deadline = time.time() + 20
+    while time.time() < deadline and not os.path.exists(pidfile):
+        time.sleep(0.1)
+    assert os.path.exists(pidfile), "worker never started"
+    time.sleep(0.2)
+    gc_pid = int(open(pidfile).read())
+    assert _alive(gc_pid)
+    return proc, gc_pid
+
+
+def test_sigkill_of_launcher_reaps_grandchildren(tmp_path):
+    proc, gc_pid = _spawn_guarded_tree(tmp_path, "SIGKILL")
+    proc.send_signal(signal.SIGKILL)  # launcher dies without cleanup
+    proc.wait()
+    assert _wait_dead(gc_pid), "grandchild survived launcher SIGKILL"
+
+
+def test_sigterm_to_middleman_reaps(tmp_path):
+    pidfile = str(tmp_path / "gc.pid")
+    worker = textwrap.dedent(f"""
+        import os, subprocess, sys, time
+        gc = subprocess.Popen([sys.executable, '-c',
+                               'import time; time.sleep(300)'])
+        open({pidfile!r}, 'w').write(str(gc.pid))
+        time.sleep(300)
+    """)
+    mid = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run.safe_exec",
+         "--watch-stdin", "--", sys.executable, "-c", worker],
+        env=_env(), stdin=subprocess.PIPE)
+    deadline = time.time() + 20
+    while time.time() < deadline and not os.path.exists(pidfile):
+        time.sleep(0.1)
+    gc_pid = int(open(pidfile).read())
+    mid.send_signal(signal.SIGTERM)
+    assert _wait_dead(gc_pid), "grandchild survived middleman SIGTERM"
+    mid.wait()
+
+
+def test_launcher_spawn_middleman_roundtrip():
+    """spawn(middleman=True) still propagates exit codes and env."""
+    proc = launcher.spawn(
+        "localhost",
+        [sys.executable, "-c",
+         "import os,sys; sys.exit(int(os.environ['WANT_RC']))"],
+        {"WANT_RC": "5", "PYTHONPATH": launcher.repo_pythonpath()},
+        middleman=True)
+    assert proc.wait(timeout=60) == 5

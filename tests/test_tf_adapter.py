@@ -34,11 +34,12 @@ def tf():
 
 
 def _tf_env():
-    """Workers must import the fake before horovod_tpu.tensorflow."""
+    """Workers must import the fake before horovod_tpu.tensorflow —
+    passed via extra_env, never by mutating this process's environ."""
     existing = os.environ.get("PYTHONPATH", "")
-    os.environ["PYTHONPATH"] = os.pathsep.join(
-        [p for p in [TESTS_DIR, existing] if p])
-    return {"JAX_PLATFORMS": "cpu"}
+    return {"JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": os.pathsep.join(
+                [p for p in [TESTS_DIR, existing] if p])}
 
 
 # ---- single-process semantics ------------------------------------------
