@@ -77,8 +77,9 @@ def main():
     # compile) and reports the post-partitioning PER-DEVICE module.
     flops_per_device_step = 0.0
     try:
-        cost = step.jitted.lower(state, images, labels) \
-            .compile().cost_analysis()
+        # step.lower places args exactly like the timed path: same cache
+        # key, so this is THE compile the loop reuses, not an extra one
+        cost = step.lower(state, images, labels).compile().cost_analysis()
         if cost:
             flops_per_device_step = float(cost.get("flops", 0.0))
     except Exception:
@@ -108,8 +109,9 @@ def main():
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-        "achieved_tflops_per_chip": round(achieved_tflops, 1),
     }
+    if achieved_tflops:  # omit rather than publish 0.0 as a measurement
+        result["achieved_tflops_per_chip"] = round(achieved_tflops, 1)
     if peak and achieved_tflops:
         mfu = 100 * achieved_tflops / peak
         if mfu <= 100:

@@ -1,0 +1,113 @@
+// Leveled, env-controlled logging for the host core.
+// Rebuilds the role of the reference's common/logging.{h,cc} (LOG(level)
+// stream macro, HOROVOD_LOG_LEVEL / HOROVOD_LOG_TIMESTAMP env control,
+// rank prefix) as a header-only utility: the hot paths must be able to
+// compile the call away when the level is off, and the negotiation loop
+// must never block on stderr — messages are single write()s.
+#pragma once
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <sstream>
+#include <string>
+
+namespace hvd {
+namespace logging {
+
+enum class Level : int { TRACE = 0, DEBUG, INFO, WARNING, ERROR, FATAL };
+
+inline const char* LevelName(Level l) {
+  switch (l) {
+    case Level::TRACE: return "trace";
+    case Level::DEBUG: return "debug";
+    case Level::INFO: return "info";
+    case Level::WARNING: return "warning";
+    case Level::ERROR: return "error";
+    case Level::FATAL: return "fatal";
+  }
+  return "?";
+}
+
+inline Level ParseLevel(const char* s) {
+  if (!s) return Level::WARNING;
+  if (!strcasecmp(s, "trace")) return Level::TRACE;
+  if (!strcasecmp(s, "debug")) return Level::DEBUG;
+  if (!strcasecmp(s, "info")) return Level::INFO;
+  if (!strcasecmp(s, "warning") || !strcasecmp(s, "warn"))
+    return Level::WARNING;
+  if (!strcasecmp(s, "error")) return Level::ERROR;
+  if (!strcasecmp(s, "fatal")) return Level::FATAL;
+  return Level::WARNING;
+}
+
+struct Config {
+  std::atomic<int> min_level{
+      static_cast<int>(ParseLevel(std::getenv("HOROVOD_LOG_LEVEL")))};
+  std::atomic<bool> timestamp{[] {
+    const char* t = std::getenv("HOROVOD_LOG_TIMESTAMP");
+    return t != nullptr && strcmp(t, "0") != 0;
+  }()};
+  std::atomic<int> rank{-1};  // set by operations.cc at init
+};
+
+inline Config& config() {
+  static Config c;
+  return c;
+}
+
+inline bool Enabled(Level l) {
+  return static_cast<int>(l) >= config().min_level.load();
+}
+
+// One-shot message builder: formats into a local buffer, emits a single
+// fwrite so concurrent threads' lines do not interleave.
+class Message {
+ public:
+  explicit Message(Level level, const char* file, int line)
+      : level_(level) {
+    if (config().timestamp.load()) {
+      char buf[32];
+      time_t now = time(nullptr);
+      struct tm tmv;
+      localtime_r(&now, &tmv);
+      strftime(buf, sizeof(buf), "%F %T", &tmv);
+      os_ << "[" << buf << "] ";
+    }
+    os_ << "[" << LevelName(level) << "]";
+    int r = config().rank.load();
+    if (r >= 0) os_ << "[rank " << r << "]";
+    os_ << " ";
+    const char* base = strrchr(file, '/');
+    os_ << (base ? base + 1 : file) << ":" << line << ": ";
+  }
+
+  template <typename T>
+  Message& operator<<(const T& v) {
+    os_ << v;
+    return *this;
+  }
+
+  ~Message() {
+    os_ << "\n";
+    std::string s = os_.str();
+    fwrite(s.data(), 1, s.size(), stderr);
+    if (level_ == Level::FATAL) abort();
+  }
+
+ private:
+  Level level_;
+  std::ostringstream os_;
+};
+
+}  // namespace logging
+}  // namespace hvd
+
+// Usage: HVD_LOG(INFO) << "controller up on " << port;
+// The condition short-circuits before any formatting when the level is
+// disabled, so TRACE/DEBUG in the cycle loop cost one atomic load.
+#define HVD_LOG(level)                                                   \
+  if (!hvd::logging::Enabled(hvd::logging::Level::level)) {              \
+  } else                                                                 \
+    hvd::logging::Message(hvd::logging::Level::level, __FILE__, __LINE__)

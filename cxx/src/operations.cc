@@ -1,3 +1,4 @@
+#include "hvd/logging.h"
 #include "hvd/operations.h"
 
 #include <algorithm>
@@ -741,6 +742,8 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
     g = nullptr;
   }
   auto* ng = new Global();
+  hvd::logging::config().rank.store(rank);
+  HVD_LOG(INFO) << "initializing host core: rank " << rank << "/" << size;
   ng->rank = rank;
   ng->size = size;
   ng->negotiator = Negotiator(size);
@@ -758,6 +761,7 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
     ng->mesh = std::make_unique<PeerMesh>(rank, size);
     Status s = ng->mesh->Start();
     if (!s.ok()) {
+      HVD_LOG(ERROR) << "peer mesh start failed: " << s.reason();
       ng->last_error = s.reason();
       g = ng;
       return 1;
@@ -769,11 +773,15 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
         advertise_host ? advertise_host : "127.0.0.1", ng->mesh->port(),
         roster);
     if (!s.ok()) {
+      HVD_LOG(ERROR) << "control-plane handshake failed: " << s.reason();
       ng->last_error = s.reason();
       g = ng;
       return 1;
     }
     ng->mesh->SetRoster(std::move(roster));
+    HVD_LOG(INFO) << "control plane up (coordinator " << coord_host << ":"
+                  << coord_port << ", mesh port " << ng->mesh->port()
+                  << ")";
   }
 
   // coordinator-only, like the reference (operations.cc:388-395)
@@ -801,11 +809,15 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
   g = ng;
   g->initialized.store(true);
   g->loop_thread = std::thread(BackgroundLoop);
+  HVD_LOG(DEBUG) << "background loop started (cycle "
+                 << ng->cycle_time_ms << " ms, fusion "
+                 << ng->fusion_threshold << " bytes)";
   return 0;
 }
 
 int hvdc_shutdown() {
   if (g == nullptr || !g->initialized.load()) return 0;
+  HVD_LOG(INFO) << "shutting down host core";
   g->shutdown_requested.store(true);
   if (g->loop_thread.joinable()) g->loop_thread.join();
   g->timeline.Shutdown();

@@ -65,13 +65,19 @@ def descendants(pid):
     return seen
 
 
-def terminate_tree(proc, grace=GRACEFUL_TERMINATION_TIME_S):
+def terminate_tree(proc, grace=GRACEFUL_TERMINATION_TIME_S, known=None):
     """SIGTERM the executor's whole tree, wait, then SIGKILL whatever is
     left — including processes that re-setsid'd out of the group
-    (reference ``terminate_executor_shell_and_children``)."""
-    if proc.poll() is not None and not descendants(proc.pid):
+    (reference ``terminate_executor_shell_and_children``).
+
+    ``known`` is a set of pids observed as descendants earlier (see the
+    tracker in run_middleman): a /proc ppid walk alone cannot find an
+    escapee whose intermediate parent already exited (it reparented to
+    init), but the tracker saw it while the parent lived."""
+    known = set(known or ())
+    if proc.poll() is not None and not (descendants(proc.pid) | known):
         return
-    tree = descendants(proc.pid) | {proc.pid}
+    tree = descendants(proc.pid) | known | {proc.pid}
     try:
         os.killpg(proc.pid, signal.SIGTERM)  # executor leads its session
     except ProcessLookupError:
@@ -83,10 +89,10 @@ def terminate_tree(proc, grace=GRACEFUL_TERMINATION_TIME_S):
             pass
     deadline = time.time() + grace
     while time.time() < deadline:
-        if proc.poll() is not None and not descendants(proc.pid):
+        if proc.poll() is not None and not _alive_set(tree - {proc.pid}):
             break
         time.sleep(0.1)
-    tree = descendants(proc.pid) | {proc.pid}
+    tree = descendants(proc.pid) | _alive_set(known) | {proc.pid}
     try:
         os.killpg(proc.pid, signal.SIGKILL)
     except ProcessLookupError:
@@ -98,16 +104,43 @@ def terminate_tree(proc, grace=GRACEFUL_TERMINATION_TIME_S):
             pass
 
 
+def _alive_set(pids):
+    out = set()
+    for p in pids:
+        try:
+            os.kill(p, 0)
+            out.add(p)
+        except OSError:
+            pass
+    return out
+
+
 def run_middleman(command, death_fd=None, watch_stdin=False, env=None):
     """Spawn ``command`` in its own session and guard it; returns the
     command's exit code (negative signal → 128+sig, shell style)."""
     proc = subprocess.Popen(command, env=env, start_new_session=True)
     fired = threading.Event()
 
+    # remember every descendant ever seen, so escapees whose parent died
+    # (reparented to init, invisible to a ppid walk) still get reaped
+    known = set()
+    known_lock = threading.Lock()
+
+    def _track():
+        while proc.poll() is None and not fired.is_set():
+            seen = descendants(proc.pid)
+            with known_lock:
+                known.update(seen)
+            time.sleep(1.0)
+
+    threading.Thread(target=_track, daemon=True).start()
+
     def _reap():
         if not fired.is_set():
             fired.set()
-            terminate_tree(proc)
+            with known_lock:
+                snapshot = set(known)
+            terminate_tree(proc, known=snapshot)
 
     def _on_signal(signum, frame):
         threading.Thread(target=_reap, daemon=True).start()

@@ -206,11 +206,11 @@ class DistributedOptimizer:
     def __init__(self, optimizer, name=None, op=Average,
                  compression=Compression.none, sparse_as_dense=False):
         self._optimizer = optimizer
-        # per-instance wire-name prefix: two unnamed wrappers around the
-        # same optimizer class must not negotiate under identical tensor
-        # names (creation order is assumed rank-consistent, as in torch)
-        self._name = name or _auto_name(
-            "opt", None) + f".Distributed{type(optimizer).__name__}"
+        # deterministic default prefix: stable across steps AND ranks so
+        # the response cache hits and negotiation never diverges. When
+        # wrapping optimizers for several models in one job, pass a
+        # distinct name= per model or their gradient names collide.
+        self._name = name or f"Distributed{type(optimizer).__name__}"
         self._op = op
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
@@ -257,12 +257,16 @@ class DistributedGradientTape:
     ``tensorflow/__init__.py:475-531``)."""
 
     def __init__(self, tape, op=Average, compression=Compression.none,
-                 sparse_as_dense=False):
+                 sparse_as_dense=False, name="tape"):
         self._tape = tape
         self._op = op
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
-        self._name = _auto_name("tape", None)  # per-instance, see above
+        # stable default: the TF2 idiom re-wraps the tape every step, so
+        # the prefix must repeat or the response cache misses every step
+        # and rank-dependent tape counts would desynchronize names. For
+        # several models in one job pass a distinct name per model.
+        self._name = name
 
     def __enter__(self):
         self._tape.__enter__()

@@ -149,6 +149,15 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                       place_data(labels))
 
     step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
+
+    def lower(state, inputs, labels):
+        """AOT lower with the SAME placement the executed path uses, so
+        the compile cache is shared and cost_analysis describes the
+        module that actually runs."""
+        return jitted.lower(place_repl(state), place_data(inputs),
+                            place_data(labels))
+
+    step.lower = lower
     return step
 
 

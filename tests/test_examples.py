@@ -55,3 +55,12 @@ def test_checkpoint_resume_example(tmp_path):
                 sys.executable, "examples/jax_checkpoint_resume.py",
                 "--ckpt-dir", ckpt, "--epochs", "8"])
     assert "resuming from step 4" in out and "epoch 8" in out
+
+
+def test_lm_seq_parallel_example():
+    out = _run([sys.executable, "examples/jax_lm_seq_parallel.py",
+                "--steps", "15", "--seq-len", "128"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8",
+                          "PALLAS_AXON_POOL_IPS": ""})
+    assert "data x seq" in out

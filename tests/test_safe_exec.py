@@ -141,3 +141,45 @@ def test_launcher_spawn_middleman_roundtrip():
         {"WANT_RC": "5", "PYTHONPATH": launcher.repo_pythonpath()},
         middleman=True)
     assert proc.wait(timeout=60) == 5
+
+
+def test_reparented_escapee_reaped(tmp_path):
+    """A grandchild whose parent exited (reparented to init) is invisible
+    to a /proc ppid walk; the middleman's tracker must still reap it."""
+    pidfile = str(tmp_path / "esc.pid")
+    # worker spawns an intermediate that setsid-spawns the escapee and
+    # then exits, severing the ppid chain
+    intermediate = textwrap.dedent(f"""
+        import subprocess, sys, time
+        gc = subprocess.Popen([sys.executable, '-c',
+                               'import time; time.sleep(300)'],
+                              start_new_session=True)
+        open({pidfile!r}, 'w').write(str(gc.pid))
+        time.sleep(3)  # stay alive long enough for the 1s tracker poll
+    """)
+    worker = textwrap.dedent(f"""
+        import subprocess, sys, time
+        subprocess.run([sys.executable, '-c', {intermediate!r}])
+        time.sleep(300)
+    """)
+    parent = textwrap.dedent(f"""
+        import os, subprocess, sys, time
+        r, w = os.pipe()
+        subprocess.Popen(
+            [sys.executable, '-m', 'horovod_tpu.run.safe_exec', str(r),
+             '--', sys.executable, '-c', {worker!r}],
+            pass_fds=(r,))
+        os.close(r)
+        time.sleep(300)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", parent], env=_env())
+    deadline = time.time() + 20
+    while time.time() < deadline and not os.path.exists(pidfile):
+        time.sleep(0.1)
+    assert os.path.exists(pidfile), "escapee never started"
+    gc_pid = int(open(pidfile).read())
+    time.sleep(5)  # intermediate exits; tracker has polled by now
+    assert _alive(gc_pid)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    assert _wait_dead(gc_pid), "reparented escapee survived launcher death"
