@@ -25,11 +25,8 @@ TFLOP/s and MFU (XLA cost-analysis FLOPs over measured step time).
 
 import argparse
 import json
-import time
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 import optax
 
 # reference docs/benchmarks.rst:28-42 — 1656.82 img/s over 16 Pascal GPUs
@@ -49,24 +46,16 @@ def main():
     args = parser.parse_args()
 
     import horovod_tpu as hvd
-    from horovod_tpu import models, training
+    from horovod_tpu import training
+    from horovod_tpu.utils.benchmarks import (make_model, synthetic_batch,
+                                              timed_throughput)
 
     hvd.init()
     ndev = hvd.num_devices()
-    platform = jax.devices()[0].platform
-    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
-
-    model_cls = {"resnet50": models.ResNet50, "resnet101": models.ResNet101,
-                 "vgg16": models.VGG16}[args.model]
-    model = model_cls(num_classes=1000, dtype=dtype)
-
+    model = make_model(args.model)
     tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
     global_batch = args.batch_size * ndev
-    rng = np.random.default_rng(0)
-    images = jnp.asarray(rng.standard_normal(
-        (global_batch, args.image_size, args.image_size, 3)), dtype)
-    labels = jnp.asarray(rng.integers(0, 1000, size=(global_batch,)),
-                         jnp.int32)
+    images, labels = synthetic_batch(global_batch, args.image_size)
 
     state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
                                         images[:1])
@@ -85,17 +74,8 @@ def main():
     except Exception:
         pass
 
-    for _ in range(args.num_warmup):
-        state, loss = step(state, images, labels)
-        jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(args.num_iters):
-        state, loss = step(state, images, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    img_per_sec = global_batch * args.num_iters / dt
+    img_per_sec, dt = timed_throughput(step, state, images, labels,
+                                       args.num_warmup, args.num_iters)
     per_chip = img_per_sec / ndev
     # cost_analysis is per-device already — no further /ndev
     achieved_tflops = flops_per_device_step * args.num_iters / dt / 1e12

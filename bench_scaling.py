@@ -1,0 +1,110 @@
+"""Scaling-efficiency harness (the reference's headline metric).
+
+The reference's published claim is 90% scaling efficiency for
+ResNet-101 at 512 GPUs (docs/benchmarks.rst:12-14): efficiency =
+(img/s at N chips) / (N x img/s at 1 chip). This script measures the
+same quantity on a TPU mesh — weak scaling, per-chip batch held
+constant — and prints one JSON line.
+
+Single-process (one host's chips): both the 1-chip baseline and the
+full mesh are measured here. Multi-host (jax.distributed): a 1-chip
+mesh is not constructible from every process, so pass the baseline
+from a prior single-chip run via ``--baseline-img-s`` (the reference's
+published efficiency numbers were computed the same way: against a
+separately measured single-GPU rate).
+
+The plumbing can be exercised anywhere with the virtual CPU mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python bench_scaling.py --model resnet18 --batch-size 2 \
+        --image-size 32 --num-iters 2
+(CPU timings are NOT meaningful TPU efficiency numbers — the flag
+exists to test the harness, matching how tests/ exercise sharding.)
+"""
+
+import argparse
+import json
+
+import jax
+import numpy as np
+import optax
+
+from horovod_tpu.utils.benchmarks import (make_model, synthetic_batch,
+                                          timed_throughput)
+
+BASELINE_EFFICIENCY = {  # reference docs/benchmarks.rst:12-14, 512 GPUs
+    "resnet101": 0.90, "resnet50": 0.90, "vgg16": 0.68}
+
+
+def _throughput(model, tx, mesh, batch_per_chip, image_size, warmup,
+                iters):
+    from horovod_tpu import training
+    images, labels = synthetic_batch(batch_per_chip * mesh.size,
+                                     image_size)
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        images[:1])
+    step = training.make_train_step(model, tx, mesh=mesh, donate=True)
+    ips, _dt = timed_throughput(step, state, images, labels, warmup,
+                                iters)
+    return ips
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet101",
+                    choices=["resnet18", "resnet50", "resnet101", "vgg16"])
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="PER-CHIP batch (held constant: weak scaling)")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-warmup", type=int, default=3)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--baseline-img-s", type=float, default=None,
+                    help="1-chip img/s from a prior run (required for "
+                         "multi-host jobs, where a 1-chip mesh is not "
+                         "constructible)")
+    args = ap.parse_args()
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    devs = np.asarray(jax.devices())
+    model = make_model(args.model)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+
+    if args.baseline_img_s is not None:
+        t1 = args.baseline_img_s
+    elif jax.process_count() > 1:
+        raise SystemExit(
+            "bench_scaling: multi-host run needs --baseline-img-s from a "
+            "prior single-chip measurement")
+    else:
+        mesh1 = jax.sharding.Mesh(devs[:1], ("data",))
+        t1 = _throughput(model, tx, mesh1, args.batch_size,
+                         args.image_size, args.num_warmup, args.num_iters)
+
+    if devs.size == 1:
+        tN, eff = t1, 1.0
+    else:
+        meshN = jax.sharding.Mesh(devs, ("data",))
+        tN = _throughput(model, tx, meshN, args.batch_size,
+                         args.image_size, args.num_warmup, args.num_iters)
+        eff = tN / (devs.size * t1)
+
+    ref = BASELINE_EFFICIENCY.get(args.model)
+    out = {
+        "metric": f"{args.model}_weak_scaling_efficiency_{devs.size}chips",
+        "value": round(eff, 4),
+        "unit": "fraction",
+        "vs_baseline": round(eff / ref, 3) if ref else None,
+        "img_per_sec_1chip": round(t1, 1),
+        "img_per_sec_full_mesh": round(tN, 1),
+        "n_devices": int(devs.size),
+    }
+    if devs.size == 1:
+        out["note"] = ("single device: efficiency trivially 1.0; run on "
+                       "a multi-chip mesh for the real number")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

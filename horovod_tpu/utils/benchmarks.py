@@ -1,0 +1,55 @@
+"""Shared scaffold for the repo's benchmark scripts (bench.py,
+bench_scaling.py): model registry, synthetic batch synthesis, and the
+warmup + timed-loop throughput measurement (reference pattern:
+``examples/pytorch_synthetic_benchmark.py:95-115``). One copy, so dtype
+and donation semantics cannot drift between scripts."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def model_registry():
+    from horovod_tpu import models
+    return {"resnet18": models.ResNet18, "resnet50": models.ResNet50,
+            "resnet101": models.ResNet101, "vgg16": models.VGG16}
+
+
+def compute_dtype():
+    """bf16 on TPU (MXU-native), f32 elsewhere (emulated bf16 on CPU is
+    slow and proves nothing)."""
+    return (jnp.bfloat16 if jax.devices()[0].platform == "tpu"
+            else jnp.float32)
+
+
+def make_model(name, dtype=None, num_classes=1000):
+    dtype = dtype if dtype is not None else compute_dtype()
+    return model_registry()[name](num_classes=num_classes, dtype=dtype)
+
+
+def synthetic_batch(global_batch, image_size, dtype=None, num_classes=1000,
+                    seed=0):
+    dtype = dtype if dtype is not None else compute_dtype()
+    rng = np.random.default_rng(seed)
+    images = jnp.asarray(rng.standard_normal(
+        (global_batch, image_size, image_size, 3)), dtype)
+    labels = jnp.asarray(rng.integers(0, num_classes,
+                                      size=(global_batch,)), jnp.int32)
+    return images, labels
+
+
+def timed_throughput(step, state, images, labels, warmup, iters):
+    """img/s of ``step`` over the timed window (async dispatch, one
+    block at the end — the sequential state dependency makes the final
+    block cover every step)."""
+    for _ in range(warmup):
+        state, loss = step(state, images, labels)
+        jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return images.shape[0] * iters / dt, dt

@@ -64,3 +64,13 @@ def test_lm_seq_parallel_example():
                           "--xla_force_host_platform_device_count=8",
                           "PALLAS_AXON_POOL_IPS": ""})
     assert "data x seq" in out
+
+
+def test_scaling_harness_tiny():
+    out = _run([sys.executable, "bench_scaling.py", "--model", "resnet18",
+                "--batch-size", "2", "--image-size", "32",
+                "--num-warmup", "1", "--num-iters", "2"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8",
+                          "PALLAS_AXON_POOL_IPS": ""})
+    assert "weak_scaling_efficiency" in out
