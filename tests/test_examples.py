@@ -8,10 +8,13 @@ import sys
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 
 
-def _run(cmd, extra_env=None, timeout=300):
+def _run(cmd, extra_env=None, timeout=300, virtual_mesh=False):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if virtual_mesh:  # the standard 8-device CPU mesh recipe
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["PALLAS_AXON_POOL_IPS"] = ""
     env.update(extra_env or {})
     rv = subprocess.run(cmd, env=env, capture_output=True, text=True,
                         timeout=timeout, cwd=REPO)
@@ -21,9 +24,7 @@ def _run(cmd, extra_env=None, timeout=300):
 
 def test_jax_mnist_example():
     out = _run([sys.executable, "examples/jax_mnist.py"],
-               extra_env={"XLA_FLAGS":
-                          "--xla_force_host_platform_device_count=8",
-                          "PALLAS_AXON_POOL_IPS": ""})
+               virtual_mesh=True)
     assert "done" in out
 
 
@@ -37,9 +38,7 @@ def test_synthetic_benchmark_tiny():
                 "--model", "resnet18", "--batch-size", "2",
                 "--image-size", "32", "--num-warmup-batches", "1",
                 "--num-batches-per-iter", "2", "--num-iters", "2"],
-               extra_env={"XLA_FLAGS":
-                          "--xla_force_host_platform_device_count=8",
-                          "PALLAS_AXON_POOL_IPS": ""})
+               virtual_mesh=True)
     assert "Img/sec per chip" in out
 
 
@@ -60,9 +59,7 @@ def test_checkpoint_resume_example(tmp_path):
 def test_lm_seq_parallel_example():
     out = _run([sys.executable, "examples/jax_lm_seq_parallel.py",
                 "--steps", "15", "--seq-len", "128"],
-               extra_env={"XLA_FLAGS":
-                          "--xla_force_host_platform_device_count=8",
-                          "PALLAS_AXON_POOL_IPS": ""})
+               virtual_mesh=True)
     assert "data x seq" in out
 
 
@@ -70,7 +67,12 @@ def test_scaling_harness_tiny():
     out = _run([sys.executable, "bench_scaling.py", "--model", "resnet18",
                 "--batch-size", "2", "--image-size", "32",
                 "--num-warmup", "1", "--num-iters", "2"],
-               extra_env={"XLA_FLAGS":
-                          "--xla_force_host_platform_device_count=8",
-                          "PALLAS_AXON_POOL_IPS": ""})
+               virtual_mesh=True)
     assert "weak_scaling_efficiency" in out
+
+
+def test_hierarchical_example():
+    out = _run([sys.executable, "examples/jax_hierarchical_allreduce.py",
+                "--steps", "3"],
+               virtual_mesh=True)
+    assert "reduce-scatter" in out and "done" in out
