@@ -35,6 +35,10 @@ class TransformerConfig:
     causal: bool = True
     # mesh axis the sequence dim is sharded over (ring attention), or None
     sequence_axis: Optional[str] = None
+    # fused Pallas flash-attention kernel for the local (non-ring) path
+    # (ops/flash_attention.py). Requires the default contiguous positions;
+    # falls back to plain XLA attention when shapes don't tile.
+    flash_attention: bool = False
 
 
 def _rotary(x, positions):
@@ -67,7 +71,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, contiguous_positions=False):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.d_model // cfg.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -80,6 +84,11 @@ class Attention(nn.Module):
             out = ring.ring_attention(
                 q, k, v, axis_name=cfg.sequence_axis, causal=cfg.causal,
                 q_positions=positions, kv_positions=positions)
+        elif cfg.flash_attention and contiguous_positions:
+            # the kernel masks by offset-contiguous positions; arbitrary
+            # user-supplied position arrays must use the dense path
+            from horovod_tpu.ops import flash_attention as fa
+            out = fa.attention(q, k, v, causal=cfg.causal)
         else:
             out = dense_attention(q, k, v, causal=cfg.causal,
                                   q_positions=positions,
@@ -92,10 +101,11 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, contiguous_positions=False):
         cfg = self.cfg
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
-        x = x + Attention(cfg, name="attn")(y, positions)
+        x = x + Attention(cfg, name="attn")(y, positions,
+                                            contiguous_positions)
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
         y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False)(y)
         y = nn.gelu(y)
@@ -116,6 +126,7 @@ class Transformer(nn.Module):
     def __call__(self, tokens, positions=None, train: bool = True):
         del train
         cfg = self.cfg
+        contiguous = positions is None  # auto positions are 0..S-1
         if positions is None:
             from horovod_tpu.parallel.ring import default_positions
             positions = default_positions(cfg.sequence_axis,
@@ -123,7 +134,7 @@ class Transformer(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model,
                      dtype=cfg.dtype, name="embed")(tokens)
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"block_{i}")(x, positions)
+            x = Block(cfg, name=f"block_{i}")(x, positions, contiguous)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, use_bias=False,
                           name="lm_head")(x)

@@ -1,0 +1,221 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+The attention hot path of the transformer family (models/transformer.py)
+as a VMEM-resident kernel: the grid is (batch*head, q-block, kv-block)
+with the kv dimension innermost, so K/V stream through VMEM one
+(block_k, d) tile at a time while fp32 scratch accumulators carry the
+online-softmax (flash) recurrence across kv steps — the S x S score
+matrix never exists and VMEM usage is bounded by the block sizes, not
+the sequence length (reference role: the fused attention kernels every
+CUDA framework hand-writes; see /opt/skills/guides/pallas_guide.md).
+
+Sequence-parallel composition: ``q_offset``/``kv_offset`` give the
+absolute position of the first query/key token. They ride a
+scalar-prefetch argument (SMEM), so traced values — e.g. derived from
+``lax.axis_index`` inside a shard_map — work; a shard holding a rotated
+K/V block passes that block's global offset and the causal mask stays
+exact. A query row with no visible keys outputs zeros (not a spurious
+mean of V).
+
+Gradients: custom VJP whose backward recomputes probabilities in plain
+XLA fp32 — activations are never saved (the flash-attention
+rematerialization policy); a fused backward kernel is a later
+optimization. Falls back transparently (``attention`` helper) to the
+plain-XLA path when shapes don't tile; the kernel itself runs anywhere
+under ``interpret=True``, which is how the CPU test suite exercises it.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # Mosaic TPU backend; absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+            *, block_q, block_k, causal, sm_scale):
+    """One (bh, q-block, kv-block) grid step. Scratch (m, l, acc) carries
+    the online-softmax state across the innermost kv dimension."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nkv = pl.num_programs(2)
+    q_off = off_ref[0]
+    kv_off = off_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_pos = (q_off + i * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+    kv_start = kv_off + j * block_k
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            kv_pos = (kv_start +
+                      jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # rows with nothing visible yet keep p = 0, so a fully-masked
+        # query outputs zeros instead of a spurious mean of V
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+        scale = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        l_ref[:] = l_ref[:] * scale + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * scale + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    if causal:
+        # skip kv blocks the causal mask kills entirely (scalar math
+        # only — extracting from a vector is a Mosaic dynamic_slice)
+        q_last = q_off + i * block_q + (block_q - 1)
+        pl.when(q_last >= kv_start)(_update)
+    else:
+        _update()
+
+    @pl.when(j == nkv - 1)
+    def _finalize():
+        l = l_ref[:]
+        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q, block_k,
+                    interpret):
+    """q: [BH, Sq, D]; k/v: [BH, Skv, D]; offsets: int32[2] -> [BH, Sq, D]."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    kern = functools.partial(_kernel, block_q=block_q, block_k=block_k,
+                             causal=causal, sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, sq // block_q, skv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b, i, j, *_: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # l
+            pltpu.VMEM((block_q, d), jnp.float32),   # acc
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(offsets, q, k, v)
+
+
+def _reference_attention(q, k, v, offsets, causal, sm_scale):
+    """Plain-XLA fp32 attention on [BH, S, D] — the backward-pass
+    recompute target and the correctness oracle in tests. Matches the
+    kernel's fully-masked-row-outputs-zero convention."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        qp = offsets[0] + jnp.arange(q.shape[1])[:, None]
+        kp = offsets[1] + jnp.arange(k.shape[1])[None, :]
+        mask = qp >= kp
+        s = jnp.where(mask, s, NEG_INF)
+        any_visible = jnp.any(mask, axis=-1)[None, :, None]
+    else:
+        any_visible = True
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(any_visible, p, 0.0)
+    return jnp.einsum("bqk,bkd->bqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, offsets, causal, sm_scale, block_q, block_k,
+           interpret):
+    return _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q,
+                           block_k, interpret)
+
+
+def _flash_fwd(q, k, v, offsets, causal, sm_scale, block_q, block_k,
+               interpret):
+    out = _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q,
+                          block_k, interpret)
+    return out, (q, k, v, offsets)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, offsets = res  # recompute-in-backward: nothing saved
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, offsets,
+                                                causal, sm_scale), q, k, v)
+    return (*vjp(g), None)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None, q_offset=0,
+                    kv_offset=0, block_q=DEFAULT_BLOCK_Q,
+                    block_k=DEFAULT_BLOCK_K, interpret=None):
+    """Fused attention on [B, S, H, D] tensors (the transformer layout).
+
+    ``q_offset``/``kv_offset`` are the absolute positions of the first
+    query/key token; ints or traced int32 scalars both work (they ride a
+    scalar-prefetch argument), so a sequence-parallel shard can pass
+    ``lax.axis_index(...) * s_local`` for a rotated K/V block."""
+    if pltpu is None:
+        raise RuntimeError("pallas TPU backend unavailable; use "
+                           "ops.flash_attention.attention (auto-fallback)")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / (float(d) ** 0.5)
+    bq = min(block_q, sq)
+    bk = min(block_k, skv)
+    if sq % bq or skv % bk:
+        raise ValueError(
+            f"flash_attention needs S divisible by the block "
+            f"(sq={sq} bq={bq}, skv={skv} bk={bk}); use "
+            f"ops.flash_attention.attention for automatic fallback")
+    offsets = jnp.asarray([q_offset, kv_offset], jnp.int32)
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(  # noqa: E731
+        b * h, x.shape[1], d)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), offsets, causal, sm_scale,
+                 bq, bk, interpret)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def attention(q, k, v, *, causal=True, q_offset=0, kv_offset=0):
+    """flash_attention with automatic fallback to the plain-XLA path
+    when shapes don't tile onto the kernel blocks."""
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, skv)
+    if pltpu is not None and sq % bq == 0 and skv % bk == 0 and d % 8 == 0:
+        return flash_attention(q, k, v, causal=causal, q_offset=q_offset,
+                               kv_offset=kv_offset)
+    offsets = jnp.asarray([q_offset, kv_offset], jnp.int32)
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(  # noqa: E731
+        b * h, x.shape[1], d)
+    out = _reference_attention(to_bh(q), to_bh(k), to_bh(v), offsets,
+                               causal, 1.0 / (float(d) ** 0.5))
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
