@@ -81,9 +81,16 @@ class Attention(nn.Module):
         v = dense("value")(x)
         if cfg.sequence_axis is not None:
             from horovod_tpu.parallel import ring
-            out = ring.ring_attention(
-                q, k, v, axis_name=cfg.sequence_axis, causal=cfg.causal,
-                q_positions=positions, kv_positions=positions)
+            if cfg.flash_attention and contiguous_positions:
+                # Pallas kernel per rotated K/V block, lse-merged
+                out = ring.ring_attention(
+                    q, k, v, axis_name=cfg.sequence_axis,
+                    causal=cfg.causal, use_flash=True)
+            else:
+                out = ring.ring_attention(
+                    q, k, v, axis_name=cfg.sequence_axis,
+                    causal=cfg.causal, q_positions=positions,
+                    kv_positions=positions)
         elif cfg.flash_attention and contiguous_positions:
             # the kernel masks by offset-contiguous positions; arbitrary
             # user-supplied position arrays must use the dense path

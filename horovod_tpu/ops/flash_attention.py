@@ -42,7 +42,7 @@ NEG_INF = -1e30
 
 
 def _kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-            *, block_q, block_k, causal, sm_scale):
+            *, block_q, block_k, causal, sm_scale, lse_ref=None):
     """One (bh, q-block, kv-block) grid step. Scratch (m, l, acc) carries
     the online-softmax state across the innermost kv dimension."""
     i = pl.program_id(1)
@@ -94,15 +94,36 @@ def _kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         l = l_ref[:]
         o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
             o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp per query row; NEG_INF marks "nothing visible"
+            # so cross-block combination gives this block zero weight
+            lse = jnp.where(l == 0.0, NEG_INF,
+                            m_ref[:] + jnp.log(jnp.where(l == 0.0, 1.0, l)))
+            lse_ref[0] = lse[:, 0]
+
+
+def _kernel_lse(off_ref, q_ref, k_ref, v_ref, o_ref, lse_out_ref, m_ref,
+                l_ref, acc_ref, **kw):
+    _kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+            lse_ref=lse_out_ref, **kw)
 
 
 def _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q, block_k,
-                    interpret):
-    """q: [BH, Sq, D]; k/v: [BH, Skv, D]; offsets: int32[2] -> [BH, Sq, D]."""
+                    interpret, with_lse=False):
+    """q: [BH, Sq, D]; k/v: [BH, Skv, D]; offsets: int32[2] -> [BH, Sq, D]
+    (plus fp32 [BH, Sq] log-sum-exp rows when ``with_lse``)."""
     bh, sq, d = q.shape
     skv = k.shape[1]
-    kern = functools.partial(_kernel, block_q=block_q, block_k=block_k,
-                             causal=causal, sm_scale=sm_scale)
+    kw = dict(block_q=block_q, block_k=block_k, causal=causal,
+              sm_scale=sm_scale)
+    kern = functools.partial(_kernel_lse if with_lse else _kernel, **kw)
+    out_specs = pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0))
+    out_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
+    if with_lse:
+        out_specs = (out_specs,
+                     pl.BlockSpec((1, block_q), lambda b, i, j, *_: (b, i)))
+        out_shape = (out_shape,
+                     jax.ShapeDtypeStruct((bh, sq), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, sq // block_q, skv // block_k),
@@ -111,8 +132,7 @@ def _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda b, i, j, *_: (b, i, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # m
             pltpu.VMEM((block_q, 1), jnp.float32),   # l
@@ -122,7 +142,7 @@ def _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q, block_k,
     return pl.pallas_call(
         kern,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(offsets, q, k, v)
 
@@ -172,6 +192,40 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def kernel_supported(sq, skv, d, block_q=DEFAULT_BLOCK_Q,
+                     block_k=DEFAULT_BLOCK_K):
+    """True when these shapes tile onto the kernel (callers use this to
+    fall back to the plain-XLA path)."""
+    if pltpu is None:
+        return False
+    bq, bk = min(block_q, sq), min(block_k, skv)
+    return sq % bq == 0 and skv % bk == 0 and d % 8 == 0
+
+
+def _prep(q, k, v, sm_scale, block_q, block_k, interpret):
+    """Shared prologue: defaulting, tiling validation, and the
+    [B,S,H,D] -> [BH,S,D] relayout."""
+    if pltpu is None:
+        raise RuntimeError("pallas TPU backend unavailable; use "
+                           "ops.flash_attention.attention (auto-fallback)")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / (float(d) ** 0.5)
+    bq, bk = min(block_q, sq), min(block_k, skv)
+    if sq % bq or skv % bk or d % 8:
+        raise ValueError(
+            f"flash_attention needs S divisible by the block and d % 8 "
+            f"== 0 (sq={sq} bq={bq}, skv={skv} bk={bk}, d={d}); use "
+            f"ops.flash_attention.attention for automatic fallback")
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    return to_bh, (b, sq, h, d), sm_scale, bq, bk, interpret
+
+
 def flash_attention(q, k, v, *, causal=True, sm_scale=None, q_offset=0,
                     kv_offset=0, block_q=DEFAULT_BLOCK_Q,
                     block_k=DEFAULT_BLOCK_K, interpret=None):
@@ -181,27 +235,35 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, q_offset=0,
     query/key token; ints or traced int32 scalars both work (they ride a
     scalar-prefetch argument), so a sequence-parallel shard can pass
     ``lax.axis_index(...) * s_local`` for a rotated K/V block."""
-    if pltpu is None:
-        raise RuntimeError("pallas TPU backend unavailable; use "
-                           "ops.flash_attention.attention (auto-fallback)")
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    b, sq, h, d = q.shape
-    skv = k.shape[1]
-    sm_scale = sm_scale if sm_scale is not None else 1.0 / (float(d) ** 0.5)
-    bq = min(block_q, sq)
-    bk = min(block_k, skv)
-    if sq % bq or skv % bk:
-        raise ValueError(
-            f"flash_attention needs S divisible by the block "
-            f"(sq={sq} bq={bq}, skv={skv} bk={bk}); use "
-            f"ops.flash_attention.attention for automatic fallback")
-    offsets = jnp.asarray([q_offset, kv_offset], jnp.int32)
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(  # noqa: E731
-        b * h, x.shape[1], d)
+    to_bh, (b, sq, h, d), sm_scale, bq, bk, interpret = _prep(
+        q, k, v, sm_scale, block_q, block_k, interpret)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(kv_offset, jnp.int32)])
     out = _flash(to_bh(q), to_bh(k), to_bh(v), offsets, causal, sm_scale,
                  bq, bk, interpret)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q, k, v, *, causal=True, sm_scale=None,
+                             q_offset=0, kv_offset=0,
+                             block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K, interpret=None):
+    """Forward-only kernel call returning ``(out, lse)`` with
+    ``lse[b, s, h]`` the log-sum-exp of each query row (NEG_INF when the
+    row sees no keys). This is the blockwise-composition primitive: ring
+    attention runs it per rotated K/V block and merges results by lse
+    weighting (parallel/ring.py). Differentiation happens at the ring
+    level, so this call is deliberately VJP-free."""
+    to_bh, (b, sq, h, d), sm_scale, bq, bk, interpret = _prep(
+        q, k, v, sm_scale, block_q, block_k, interpret)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(kv_offset, jnp.int32)])
+    out, lse = _flash_fwd_impl(to_bh(q), to_bh(k), to_bh(v), offsets,
+                               causal, sm_scale, bq, bk, interpret,
+                               with_lse=True)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    return out, lse
 
 
 def attention(q, k, v, *, causal=True, q_offset=0, kv_offset=0):
@@ -209,13 +271,14 @@ def attention(q, k, v, *, causal=True, q_offset=0, kv_offset=0):
     when shapes don't tile onto the kernel blocks."""
     b, sq, h, d = q.shape
     skv = k.shape[1]
-    bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, skv)
-    if pltpu is not None and sq % bq == 0 and skv % bk == 0 and d % 8 == 0:
+    if kernel_supported(sq, skv, d):
         return flash_attention(q, k, v, causal=causal, q_offset=q_offset,
                                kv_offset=kv_offset)
     offsets = jnp.asarray([q_offset, kv_offset], jnp.int32)
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(  # noqa: E731
-        b * h, x.shape[1], d)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
     out = _reference_attention(to_bh(q), to_bh(k), to_bh(v), offsets,
                                causal, 1.0 / (float(d) ** 0.5))
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -57,13 +57,26 @@ def _block_update(q, k, v, q_pos, kv_pos, m, l, o, causal, scale):
 
 
 def ring_attention(q, k, v, axis_name, causal=True, q_positions=None,
-                   kv_positions=None):
+                   kv_positions=None, use_flash=False):
     """Blockwise ring attention over the ``axis_name`` mesh axis.
 
     Shapes per shard: q/k/v ``[B, S_local, H, D]``; positions ``[B, S_local]``
     absolute token positions (used for causal masking across shards).
     Returns ``[B, S_local, H, D]`` in q.dtype.
+
+    ``use_flash`` runs each shard's block attention through the Pallas
+    flash kernel (ops/flash_attention.py) and merges blocks by
+    log-sum-exp weighting; requires the DEFAULT contiguous positions
+    (pass ``q_positions=None``) and tiling shapes — callers with custom
+    positions keep the jnp path.
     """
+    if use_flash and q_positions is None and kv_positions is None:
+        from horovod_tpu.ops import flash_attention as fa
+        _, sq_, _, d_ = q.shape
+        if fa.kernel_supported(sq_, sq_, d_):
+            return _ring_attention_flash(q, k, v, axis_name, causal)
+        # shapes don't tile onto the kernel: silently use the jnp ring,
+        # same fallback contract as the local attention() helper
     n = lax.axis_size(axis_name)
     b, sq, h, d = q.shape
     scale = 1.0 / (float(d) ** 0.5)
@@ -91,6 +104,75 @@ def ring_attention(q, k, v, axis_name, causal=True, q_positions=None,
     l = jnp.where(l == 0.0, 1.0, l)
     out = (o / l[..., None]).astype(q.dtype)
     return jnp.einsum("bhqd->bqhd", out)
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal):
+    """Ring attention whose per-block compute is the Pallas flash kernel
+    (forward); blocks merge by the standard log-sum-exp composition:
+    ``out = sum_j exp(lse_j - LSE) * out_j``. Backward differentiates the
+    jnp ring path instead (custom VJP) — same rematerialization policy as
+    the local flash kernel, and the collectives replay identically."""
+    from horovod_tpu.ops import flash_attention as fa
+
+    n = lax.axis_size(axis_name)
+    b, sq, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def fwd_impl(q, k, v):
+        # axis_index must be taken INSIDE the custom_vjp'd function: a
+        # closed-over tracer has no constant handler under grad tracing
+        me = lax.axis_index(axis_name)
+        q_off = (me * sq).astype(jnp.int32)
+
+        def step(carry, _):
+            k_blk, v_blk, kv_off, o_run, lse_run = carry
+            o_j, lse_j = fa.flash_attention_with_lse(
+                q, k_blk, v_blk, causal=causal, q_offset=q_off,
+                kv_offset=kv_off[0])
+            # streaming log-sum-exp merge (elementwise, XLA-fused)
+            m = jnp.maximum(lse_run, lse_j)
+            m_safe = jnp.where(m <= _NEG_BIG / 2, 0.0, m)
+            w_run = jnp.where(lse_run <= _NEG_BIG / 2, 0.0,
+                              jnp.exp(lse_run - m_safe))
+            w_j = jnp.where(lse_j <= _NEG_BIG / 2, 0.0,
+                            jnp.exp(lse_j - m_safe))
+            tot = w_run + w_j
+            tot_safe = jnp.where(tot == 0.0, 1.0, tot)
+            # fp32 carry across all n steps; cast once after the scan
+            # (repeated bf16 re-rounding would compound over the ring)
+            o_run = ((o_run * w_run[..., None]
+                      + o_j.astype(jnp.float32) * w_j[..., None])
+                     / tot_safe[..., None])
+            lse_run = jnp.where(tot == 0.0, _NEG_BIG,
+                                m_safe + jnp.log(tot_safe))
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            kv_off = lax.ppermute(kv_off, axis_name, perm)
+            return (k_blk, v_blk, kv_off, o_run, lse_run), None
+
+        kv_off0 = (me * sq).astype(jnp.int32)[None]
+        o0 = jnp.zeros(q.shape, jnp.float32)
+        lse0 = jnp.full((b, sq, h), _NEG_BIG, jnp.float32)
+        (_, _, _, out, _), _ = lax.scan(
+            step, (k, v, kv_off0, o0, lse0), None, length=n)
+        return out.astype(q.dtype)
+
+    @jax.custom_vjp
+    def run(q, k, v):
+        return fwd_impl(q, k, v)
+
+    def run_fwd(q, k, v):
+        return fwd_impl(q, k, v), (q, k, v)
+
+    def run_bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name,
+                                              causal=causal), q, k, v)
+        return vjp(g)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(q, k, v)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=True, q_positions=None,
