@@ -1,0 +1,99 @@
+"""Synthetic LM training benchmark: tokens/sec through the framework
+hot path (DistributedOptimizer -> exact sharded LM loss -> optimizer),
+the language-model sibling of ``jax_synthetic_benchmark.py`` (reference
+pattern: ``examples/pytorch_synthetic_benchmark.py`` timed batches).
+
+Single chip (flash attention on TPU):
+
+    python examples/jax_lm_benchmark.py --seq-len 2048
+
+Sequence-parallel over a mesh (ring attention, flash per block):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/jax_lm_benchmark.py --data 2 --seq 4 --steps 3 \
+        --layers 2 --d-model 64 --seq-len 1024
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import training
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", type=int, default=1, help="data-axis size")
+    ap.add_argument("--seq", type=int, default=1, help="seq-axis size")
+    ap.add_argument("--batch", type=int, default=8, help="global batch")
+    ap.add_argument("--seq-len", type=int, default=2048,
+                    help="global sequence length")
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--no-flash", action="store_true")
+    args = ap.parse_args()
+
+    hvd.init()
+    devs = np.asarray(jax.devices())
+    n_used = args.data * args.seq
+    assert devs.size >= n_used, f"need {n_used} devices, have {devs.size}"
+    mesh = jax.sharding.Mesh(devs[:n_used].reshape(args.data, args.seq),
+                             ("data", "seq"))
+
+    dtype = (jnp.bfloat16 if devs[0].platform == "tpu" else jnp.float32)
+    seq_axis = "seq" if args.seq > 1 else None
+    cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
+                            num_heads=args.heads, d_model=args.d_model,
+                            d_ff=4 * args.d_model, dtype=dtype,
+                            sequence_axis=seq_axis,
+                            flash_attention=not args.no_flash)
+    init_cfg = TransformerConfig(**{**cfg.__dict__, "sequence_axis": None,
+                                    "flash_attention": False})
+
+    tx = hvd.DistributedOptimizer(
+        optax.adamw(3e-4),
+        axes=("data", "seq") if seq_axis else ("data",))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, args.vocab,
+                                      size=(args.batch, args.seq_len)),
+                         jnp.int32)
+    state = training.create_train_state(Transformer(init_cfg), tx,
+                                        jax.random.PRNGKey(0), tokens[:1])
+    step = training.make_lm_train_step(
+        Transformer(cfg), tx, mesh=mesh, batch_axis="data",
+        seq_axis=seq_axis)
+
+    for _ in range(args.warmup):
+        state, loss = step(state, tokens)
+        jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = args.batch * args.seq_len * args.steps / dt
+    print(json.dumps({
+        "metric": "transformer_lm_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec",
+        "seq_len": args.seq_len,
+        "mesh": {"data": args.data, "seq": args.seq},
+        "flash_attention": not args.no_flash,
+        "final_loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -199,7 +199,10 @@ def kernel_supported(sq, skv, d, block_q=DEFAULT_BLOCK_Q,
     if pltpu is None:
         return False
     bq, bk = min(block_q, sq), min(block_k, skv)
-    return sq % bq == 0 and skv % bk == 0 and d % 8 == 0
+    # blocks must also respect the fp32 sublane tile (8) or Mosaic can
+    # reject the lowering — the fallback contract depends on this gate
+    return (sq % bq == 0 and skv % bk == 0 and d % 8 == 0
+            and bq % 8 == 0 and bk % 8 == 0)
 
 
 def _prep(q, k, v, sm_scale, block_q, block_k, interpret):
@@ -214,11 +217,12 @@ def _prep(q, k, v, sm_scale, block_q, block_k, interpret):
     skv = k.shape[1]
     sm_scale = sm_scale if sm_scale is not None else 1.0 / (float(d) ** 0.5)
     bq, bk = min(block_q, sq), min(block_k, skv)
-    if sq % bq or skv % bk or d % 8:
+    if sq % bq or skv % bk or d % 8 or bq % 8 or bk % 8:
         raise ValueError(
-            f"flash_attention needs S divisible by the block and d % 8 "
-            f"== 0 (sq={sq} bq={bq}, skv={skv} bk={bk}, d={d}); use "
-            f"ops.flash_attention.attention for automatic fallback")
+            f"flash_attention needs S divisible by the block, blocks "
+            f"divisible by 8, and d % 8 == 0 (sq={sq} bq={bq}, skv={skv} "
+            f"bk={bk}, d={d}); use ops.flash_attention.attention for "
+            f"automatic fallback")
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)

@@ -76,3 +76,12 @@ def test_hierarchical_example():
                 "--steps", "3"],
                virtual_mesh=True)
     assert "reduce-scatter" in out and "done" in out
+
+
+def test_lm_benchmark_tiny():
+    out = _run([sys.executable, "examples/jax_lm_benchmark.py",
+                "--data", "2", "--seq", "4", "--steps", "2", "--warmup", "1",
+                "--layers", "2", "--d-model", "64", "--heads", "4",
+                "--vocab", "128", "--seq-len", "512", "--batch", "4"],
+               virtual_mesh=True)
+    assert "transformer_lm_tokens_per_sec" in out

@@ -87,15 +87,21 @@ def test_traced_offsets_under_jit():
 
 def test_attention_fallback_on_odd_shapes():
     rng = np.random.default_rng(3)
+    # S=100: block would be 100, not sublane-aligned -> must fall back
+    assert not fa.kernel_supported(100, 100, 32)
     q = jnp.asarray(rng.standard_normal((1, 100, 2, 32)), jnp.float32)
     k, v = q + 1, q - 1
-    out = fa.attention(q, k, v, causal=True)  # 100 % 100 == 0 -> kernel
-    assert out.shape == q.shape
-    # S=100 with block min(128,100)=100 divides; also exercise fallback
+    out = fa.attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_oracle(q, k, v)), atol=2e-5)
+    # d % 8 != 0 -> jnp path
+    assert not fa.kernel_supported(128, 128, 30)
     q2 = jnp.asarray(rng.standard_normal((1, 90, 2, 30)), jnp.float32)
-    out2 = fa.attention(q2, q2, q2, causal=True)  # d%8 != 0 -> jnp path
+    out2 = fa.attention(q2, q2, q2, causal=True)
     np.testing.assert_allclose(np.asarray(out2),
                                np.asarray(_oracle(q2, q2, q2)), atol=2e-5)
+    # aligned sub-128 sequences DO take the kernel
+    assert fa.kernel_supported(96, 96, 32)
 
 
 def test_transformer_flash_matches_dense():
