@@ -17,12 +17,16 @@ K/V block passes that block's global offset and the causal mask stays
 exact. A query row with no visible keys outputs zeros (not a spurious
 mean of V).
 
-Gradients: custom VJP whose backward recomputes probabilities in plain
-XLA fp32 — activations are never saved (the flash-attention
-rematerialization policy); a fused backward kernel is a later
-optimization. Falls back transparently (``attention`` helper) to the
-plain-XLA path when shapes don't tile; the kernel itself runs anywhere
-under ``interpret=True``, which is how the CPU test suite exercises it.
+Gradients: custom VJP with **fused backward kernels** — a dQ pass
+(kv-blocks streamed) and a dK/dV pass (q-blocks streamed), each
+recomputing P blockwise from (q, k, lse) saved by the forward — so the
+backward, like the forward, never materializes S x S and stays
+O(S * block) in memory (the flash-attention rematerialization policy).
+Kernel matmuls run at the MXU's default precision with fp32
+accumulation, matching XLA's own default on TPU. Falls back
+transparently (``attention`` helper) to the plain-XLA path when shapes
+don't tile; the kernels run anywhere under ``interpret=True``, which is
+how the CPU test suite exercises them.
 """
 
 import functools
@@ -97,9 +101,9 @@ def _kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         if lse_ref is not None:
             # log-sum-exp per query row; NEG_INF marks "nothing visible"
             # so cross-block combination gives this block zero weight
-            lse = jnp.where(l == 0.0, NEG_INF,
-                            m_ref[:] + jnp.log(jnp.where(l == 0.0, 1.0, l)))
-            lse_ref[0] = lse[:, 0]
+            lse_ref[0] = jnp.where(
+                l == 0.0, NEG_INF,
+                m_ref[:] + jnp.log(jnp.where(l == 0.0, 1.0, l)))
 
 
 def _kernel_lse(off_ref, q_ref, k_ref, v_ref, o_ref, lse_out_ref, m_ref,
@@ -111,7 +115,8 @@ def _kernel_lse(off_ref, q_ref, k_ref, v_ref, o_ref, lse_out_ref, m_ref,
 def _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q, block_k,
                     interpret, with_lse=False):
     """q: [BH, Sq, D]; k/v: [BH, Skv, D]; offsets: int32[2] -> [BH, Sq, D]
-    (plus fp32 [BH, Sq] log-sum-exp rows when ``with_lse``)."""
+    (plus fp32 [BH, Sq, 1] log-sum-exp rows when ``with_lse`` — the
+    trailing singleton satisfies Mosaic's last-two-dims tiling rule)."""
     bh, sq, d = q.shape
     skv = k.shape[1]
     kw = dict(block_q=block_q, block_k=block_k, causal=causal,
@@ -120,10 +125,13 @@ def _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q, block_k,
     out_specs = pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0))
     out_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
     if with_lse:
+        # lse rides as [BH, Sq, 1]: a (1, bq, 1) block satisfies the
+        # Mosaic last-two-dims tiling rule where a 2-D (1, bq) cannot
         out_specs = (out_specs,
-                     pl.BlockSpec((1, block_q), lambda b, i, j, *_: (b, i)))
+                     pl.BlockSpec((1, block_q, 1),
+                                  lambda b, i, j, *_: (b, i, 0)))
         out_shape = (out_shape,
-                     jax.ShapeDtypeStruct((bh, sq), jnp.float32))
+                     jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, sq // block_q, skv // block_k),
@@ -167,6 +175,161 @@ def _reference_attention(q, k, v, offsets, causal, sm_scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _dq_kernel(off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, block_q, block_k, causal, sm_scale):
+    """Backward dQ pass: grid (bh, q-block, kv-block), kv innermost.
+    Recomputes P from (q, k, lse) blockwise — flash backward proper."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nkv = pl.num_programs(2)
+    q_off = off_ref[0]
+    kv_off = off_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = (q_off + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0))
+            kv_pos = (kv_off + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1))
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        # p = exp(s - lse); rows with nothing visible have lse=NEG_INF
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        q_last = q_off + i * block_q + (block_q - 1)
+        pl.when(q_last >= kv_off + j * block_k)(_update)
+    else:
+        _update()
+
+    @pl.when(j == nkv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
+                causal, sm_scale):
+    """Backward dK/dV pass: grid (bh, kv-block, q-block), q innermost."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    nq = pl.num_programs(2)
+    q_off = off_ref[0]
+    kv_off = off_ref[1]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = (q_off + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0))
+            kv_pos = (kv_off + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1))
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dv_acc[:] += jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    if causal:
+        q_last = q_off + i * block_q + (block_q - 1)
+        pl.when(q_last >= kv_off + j * block_k)(_update)
+    else:
+        _update()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, g, out, lse, offsets, causal, sm_scale,
+                    block_q, block_k, interpret):
+    """Fused flash backward: dq pass then dk/dv pass, each streaming the
+    other operand; memory is O(S * block), never O(S^2)."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    # delta_i = sum_d dO * O — the softmax-jacobian row correction
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, Sq, 1]
+    kw = dict(block_q=block_q, block_k=block_k, causal=causal,
+              sm_scale=sm_scale)
+    qspec = lambda b, i, j, *_: (b, i, 0)      # noqa: E731
+    kspec = lambda b, i, j, *_: (b, j, 0)      # noqa: E731
+    rowspec = lambda b, i, j, *_: (b, i, 0)    # noqa: E731
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **kw),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, sq // block_q, skv // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), qspec),
+                pl.BlockSpec((1, block_k, d), kspec),
+                pl.BlockSpec((1, block_k, d), kspec),
+                pl.BlockSpec((1, block_q, d), qspec),
+                pl.BlockSpec((1, block_q, 1), rowspec),
+                pl.BlockSpec((1, block_q, 1), rowspec),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), qspec),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(offsets, q, k, v, g, lse, delta)
+
+    # second pass: kv-block outer, q-block inner
+    qspec2 = lambda b, j, i, *_: (b, i, 0)     # noqa: E731
+    kspec2 = lambda b, j, i, *_: (b, j, 0)     # noqa: E731
+    rowspec2 = lambda b, j, i, *_: (b, i, 0)   # noqa: E731
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **kw),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, skv // block_k, sq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), qspec2),
+                pl.BlockSpec((1, block_k, d), kspec2),
+                pl.BlockSpec((1, block_k, d), kspec2),
+                pl.BlockSpec((1, block_q, d), qspec2),
+                pl.BlockSpec((1, block_q, 1), rowspec2),
+                pl.BlockSpec((1, block_q, 1), rowspec2),
+            ],
+            out_specs=(pl.BlockSpec((1, block_k, d), kspec2),
+                       pl.BlockSpec((1, block_k, d), kspec2)),
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+        ),
+        out_shape=(jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype)),
+        interpret=interpret,
+    )(offsets, q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, offsets, causal, sm_scale, block_q, block_k,
            interpret):
@@ -176,17 +339,16 @@ def _flash(q, k, v, offsets, causal, sm_scale, block_q, block_k,
 
 def _flash_fwd(q, k, v, offsets, causal, sm_scale, block_q, block_k,
                interpret):
-    out = _flash_fwd_impl(q, k, v, offsets, causal, sm_scale, block_q,
-                          block_k, interpret)
-    return out, (q, k, v, offsets)
+    out, lse = _flash_fwd_impl(q, k, v, offsets, causal, sm_scale,
+                               block_q, block_k, interpret, with_lse=True)
+    return out, (q, k, v, offsets, out, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, offsets = res  # recompute-in-backward: nothing saved
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, offsets,
-                                                causal, sm_scale), q, k, v)
-    return (*vjp(g), None)
+    q, k, v, offsets, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, g, out, lse, offsets, causal,
+                                 sm_scale, block_q, block_k, interpret)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -217,7 +379,7 @@ def _prep(q, k, v, sm_scale, block_q, block_k, interpret):
     skv = k.shape[1]
     sm_scale = sm_scale if sm_scale is not None else 1.0 / (float(d) ** 0.5)
     bq, bk = min(block_q, sq), min(block_k, skv)
-    if sq % bq or skv % bk or d % 8 or bq % 8 or bk % 8:
+    if not kernel_supported(sq, skv, d, block_q, block_k):
         raise ValueError(
             f"flash_attention needs S divisible by the block, blocks "
             f"divisible by 8, and d % 8 == 0 (sq={sq} bq={bq}, skv={skv} "
@@ -266,7 +428,7 @@ def flash_attention_with_lse(q, k, v, *, causal=True, sm_scale=None,
                                causal, sm_scale, bq, bk, interpret,
                                with_lse=True)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)  # [BH,Sq,1] -> [B,S,H]
     return out, lse
 
 
