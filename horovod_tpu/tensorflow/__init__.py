@@ -11,6 +11,15 @@ Covered contracts:
   (reference ``__init__.py:43-118``: sparse gradients allgather values
   and indices instead of reducing dense zeros),
 * fp16 wire compression on the dense path (reference Compression),
+* **graph mode with registered gradients**: on a real TF, the dense
+  collectives route through ``tf.numpy_function`` wrapped in
+  ``tf.custom_gradient``, so they work inside ``tf.function`` (Keras 3
+  traces ``model.fit``'s train step) and are differentiable — the role
+  of the reference's ``AsyncOpKernel`` + gradient registrations
+  (``tensorflow/mpi_ops.cc:287-460``, ``mpi_ops.py``):
+  grad(allreduce) = allreduce(grad); grad(allgather) = allreduce(grad)
+  sliced to the local rows; grad(broadcast) = summed grad on root,
+  zeros elsewhere,
 * ``DistributedOptimizer`` overriding ``compute_gradients`` (reference
   ``__init__.py:266-311``) with ``sparse_as_dense`` option,
 * ``DistributedGradientTape`` for TF2 eager (``__init__.py:475-531``),
@@ -19,10 +28,10 @@ Covered contracts:
 * ``horovod_tpu.tensorflow.keras.load_model`` wrapping saved optimizers
   in DistributedOptimizer (reference ``keras/__init__.py:117-150``).
 
-TensorFlow is not part of this image's baked environment, so the module
-import-gates; the adapter logic is exercised in-image against a
-numpy-backed stand-in (``tests/fake_tensorflow.py``) the same way the
-MXNet adapter is — the code paths are identical either way.
+The adapter runs against real TF (``tests/test_tf_real.py`` — eager,
+``tf.function``, Keras 3 ``model.fit``) when tensorflow is importable,
+and against the numpy-backed stand-in (``tests/fake_tensorflow.py``)
+otherwise; the fake keeps in-image coverage when TF is absent.
 """
 
 try:
@@ -100,6 +109,115 @@ def _to_numpy(tensor):
     return np.asarray(tensor)
 
 
+# Real TF exposes the three pieces the graph bridge needs; the test fake
+# does not, and falls back to the plain eager numpy path below.
+_GRAPH_OK = all(hasattr(tf, a) for a in
+                ("numpy_function", "custom_gradient", "executing_eagerly"))
+
+
+def _bridge(host_fn, x, out_shape):
+    """Run ``host_fn(np.ndarray) -> np.ndarray`` on ``x`` in either
+    execution mode: direct in eager, via ``tf.numpy_function`` under
+    ``tf.function`` (the host data plane is CPU-side either way, exactly
+    like the reference's AsyncOpKernel handing the tensor to the
+    background loop)."""
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(host_fn(np.asarray(x.numpy())))
+    y = tf.numpy_function(host_fn, [x], x.dtype)
+    y.set_shape(out_shape)
+    return y
+
+
+def _graph_allreduce(tensor, name, op, compression):
+    """Differentiable allreduce (reference ``_allreduce_grad``,
+    ``tensorflow/mpi_ops.py``: the gradient of an allreduce is an
+    allreduce of the gradient with the same op)."""
+    core = _ensure_core()
+
+    def _host(arr, wire_name):
+        arr = np.asarray(arr)
+        c, dt = compression.compress(arr)
+        out = core.allreduce(c, wire_name, op=op)
+        # the host core flattens 0-d tensors to (1,); restore the shape
+        return np.asarray(compression.decompress(np.asarray(out), dt),
+                          dtype=arr.dtype).reshape(arr.shape)
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _bridge(lambda a: _host(a, name), x, x.shape)
+
+        def grad(dy):
+            return _bridge(lambda a: _host(a, name + ".grad"), dy,
+                           dy.shape)
+        return y, grad
+
+    return _fn(tf.convert_to_tensor(tensor))
+
+
+def _graph_allgather(tensor, name):
+    """Differentiable allgather. Backward is the reference's
+    ``HorovodAllgatherGrad``: allreduce-sum the gathered-output gradient,
+    then slice out the rows this rank contributed."""
+    core = _ensure_core()
+    # local row count + exact input shape, recorded by the forward host
+    # fn so the backward slice matches the input even for 0-d tensors
+    fwd_meta = [None, None]
+
+    def _host_fwd(arr):
+        arr = np.asarray(arr)
+        fwd_meta[0] = arr.shape[0] if arr.ndim else 1
+        fwd_meta[1] = arr.shape
+        return np.asarray(core.allgather(arr, name))
+
+    def _host_grad(dy):
+        dy = np.asarray(dy)
+        nrows, in_shape = fwd_meta
+        sizes = np.asarray(core.allgather(
+            np.array([nrows], np.int64), name + ".grad.nrows"))
+        g = np.asarray(core.allreduce(dy, name + ".grad", op=Sum))
+        offset = int(sizes[:rank()].sum())
+        return np.ascontiguousarray(
+            g[offset:offset + nrows]).reshape(in_shape)
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _bridge(_host_fwd, x, [None] + list(x.shape[1:]))
+
+        def grad(dy):
+            return _bridge(_host_grad, dy, x.shape)
+        return y, grad
+
+    return _fn(tf.convert_to_tensor(tensor))
+
+
+def _graph_broadcast(tensor, name, root_rank):
+    """Differentiable broadcast: every rank allreduce-sums the upstream
+    gradient, the root keeps it, the others zero it (reference
+    ``_broadcast_grad``)."""
+    core = _ensure_core()
+
+    def _host_fwd(arr):
+        arr = np.asarray(arr)
+        out = np.asarray(core.broadcast(arr, name, root_rank=root_rank))
+        return out.reshape(arr.shape)  # 0-d safety, as in allreduce
+
+    def _host_grad(dy):
+        dy = np.asarray(dy)
+        g = np.asarray(core.allreduce(dy, name + ".grad",
+                                      op=Sum)).reshape(dy.shape)
+        return g if rank() == root_rank else np.zeros_like(g)
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _bridge(_host_fwd, x, x.shape)
+
+        def grad(dy):
+            return _bridge(_host_grad, dy, dy.shape)
+        return y, grad
+
+    return _fn(tf.convert_to_tensor(tensor))
+
+
 def allreduce(tensor, average=None, name=None, op=None,
               compression=Compression.none):
     """Allreduce a tf.Tensor — or allgather an ``tf.IndexedSlices``
@@ -126,32 +244,43 @@ def allreduce(tensor, average=None, name=None, op=None,
             values = values / float(size())
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
+    wire = _auto_name("allreduce", name)
+    if _GRAPH_OK:
+        return _graph_allreduce(tensor, wire, op, compression)
     core = _ensure_core()
     arr = _to_numpy(tensor)
     compressed, dtype = compression.compress(arr)
-    out = core.allreduce(compressed, _auto_name("allreduce", name), op=op)
+    out = core.allreduce(compressed, wire, op=op)
     return tf.convert_to_tensor(compression.decompress(np.asarray(out),
                                                        dtype))
 
 
 def allgather(tensor, name=None):
+    wire = _auto_name("allgather", name)
+    if _GRAPH_OK:
+        return _graph_allgather(tensor, wire)
     core = _ensure_core()
-    out = core.allgather(_to_numpy(tensor), _auto_name("allgather", name))
+    out = core.allgather(_to_numpy(tensor), wire)
     return tf.convert_to_tensor(np.asarray(out))
 
 
 def broadcast(tensor, root_rank=0, name=None):
+    wire = _auto_name("broadcast", name)
+    if _GRAPH_OK:
+        return _graph_broadcast(tensor, wire, root_rank)
     core = _ensure_core()
-    out = core.broadcast(_to_numpy(tensor), _auto_name("broadcast", name),
-                         root_rank=root_rank)
+    out = core.broadcast(_to_numpy(tensor), wire, root_rank=root_rank)
     return tf.convert_to_tensor(np.asarray(out))
 
 
 def broadcast_variables(variables, root_rank=0):
     """Assign every variable rank ``root_rank``'s value (reference
     ``broadcast_variables``, ``tensorflow/__init__.py:139``)."""
+    # convert_to_tensor (not v.value()) so Keras-3 variables — where
+    # .value is a property, not a method — work alongside tf.Variable
     for i, v in enumerate(variables):
-        v.assign(broadcast(v.value(), root_rank, name=f"bv.{i}"))
+        v.assign(broadcast(tf.convert_to_tensor(v), root_rank,
+                           name=f"bv.{i}"))
 
 
 def broadcast_global_variables(root_rank=0):
@@ -174,6 +303,10 @@ def broadcast_global_variables(root_rank=0):
 def _sparse_to_dense(tensor):
     if not isinstance(tensor, tf.IndexedSlices):
         return tensor
+    if _GRAPH_OK:
+        # real TF scatter-adds IndexedSlices in its converter, and this
+        # stays symbolic-safe inside tf.function
+        return tf.convert_to_tensor(tensor)
     values = _to_numpy(tensor.values)
     indices = _to_numpy(tensor.indices).astype(np.int64)
     shape = tensor.dense_shape

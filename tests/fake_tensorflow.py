@@ -71,6 +71,9 @@ class Variable:
         self._data = np.array(np.asarray(value), copy=True)
         return self
 
+    def __array__(self, dtype=None):
+        return self._data if dtype is None else self._data.astype(dtype)
+
 
 class GradientTape:
     """Preset-gradient tape: real autodiff is TF's business, the adapter

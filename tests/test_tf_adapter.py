@@ -227,3 +227,112 @@ def test_empty_var_list_ok(hvd_tf, tf):
 def test_broadcast_global_variables_raises_without_collections(hvd_tf):
     with pytest.raises(NotImplementedError, match="model.variables"):
         hvd_tf.broadcast_global_variables(0)
+
+
+# ---- keras callbacks against the fake (real-TF runs: test_tf_real) ----
+
+class _Model:
+    """Minimal model stub: the callbacks only touch .variables and
+    .optimizer."""
+
+    def __init__(self, optimizer, variables=()):
+        self.optimizer = optimizer
+        self.variables = list(variables)
+
+
+def test_broadcast_callback_fires_once(hvd_tf, tf):
+    from horovod_tpu.tensorflow import callbacks as cb
+    v = tf.Variable(np.full(2, 3.0, np.float32))
+    inner = tf.train.Optimizer(lr=0.2)
+    c = cb.BroadcastGlobalVariablesCallback(0)
+    c.set_model(_Model(inner, [v]))
+    c.on_train_batch_end(0)  # size 1: broadcast is identity
+    assert c.broadcast_done
+    np.testing.assert_array_equal(v.numpy(), np.full(2, 3.0))
+    c.on_train_batch_end(1)  # second call is a no-op
+
+
+def test_metric_average_callback_inplace(hvd_tf, tf):
+    from horovod_tpu.tensorflow import callbacks as cb
+    c = cb.MetricAverageCallback()
+    logs = {"loss": 2.0, "acc": 0.5, "name": "not-a-number"}
+    c.on_epoch_end(0, logs)
+    assert logs["loss"] == 2.0 and logs["acc"] == 0.5  # size 1 identity
+    assert isinstance(logs["loss"], float)
+    assert logs["name"] == "not-a-number"
+
+
+def test_lr_schedule_staircase_and_momentum_correction(hvd_tf, tf):
+    from horovod_tpu.tensorflow import callbacks as cb
+    inner = tf.train.Optimizer(lr=0.2)
+    # variable-backed momentum: assignment is visible to a compiled
+    # train step, so the callback applies the correction
+    inner.momentum = tf.Variable(np.float64(0.9))
+    c = cb.LearningRateScheduleCallback(0.5)
+    c.set_model(_Model(inner))
+    c.on_train_begin()
+    c.on_epoch_begin(0)
+    c.on_batch_begin(0)
+    assert abs(inner.lr - 0.1) < 1e-9
+    # momentum scaled by new_lr/old_lr while the batch runs...
+    assert abs(float(np.asarray(inner.momentum)) - 0.45) < 1e-9
+    c.on_batch_end(0)  # ...and restored afterwards
+    assert abs(float(np.asarray(inner.momentum)) - 0.9) < 1e-9
+    logs = {}
+    c.on_epoch_end(0, logs)
+    assert abs(logs["lr"] - 0.1) < 1e-9
+
+
+def test_lr_schedule_skips_float_momentum_with_warning(hvd_tf, tf):
+    """Keras-3-style plain-float momentum is baked into the traced step,
+    so the callback must refuse to scale it (and say so once)."""
+    import warnings
+    from horovod_tpu.tensorflow import callbacks as cb
+    inner = tf.train.Optimizer(lr=0.2)
+    inner.momentum = 0.9
+    c = cb.LearningRateScheduleCallback(0.5)
+    c.set_model(_Model(inner))
+    c.on_train_begin()
+    c.on_epoch_begin(0)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        c.on_batch_begin(0)
+        c.on_batch_end(0)
+        c.on_batch_begin(1)  # warning fires only once
+    assert abs(inner.lr - 0.1) < 1e-9  # lr still adjusted
+    assert inner.momentum == 0.9      # momentum untouched
+    assert sum("momentum_correction skipped" in str(w.message)
+               for w in caught) == 1
+
+
+def test_lr_schedule_respects_epoch_window(hvd_tf, tf):
+    from horovod_tpu.tensorflow import callbacks as cb
+    inner = tf.train.Optimizer(lr=0.2)
+    c = cb.LearningRateScheduleCallback(
+        lambda epoch: 0.5 ** epoch, start_epoch=1, end_epoch=2)
+    c.set_model(_Model(inner))
+    c.on_train_begin()
+    c.on_epoch_begin(0)
+    c.on_batch_begin(0)
+    assert abs(inner.lr - 0.2) < 1e-9  # before start_epoch: untouched
+    c.on_epoch_begin(1)
+    c.on_batch_begin(0)
+    assert abs(inner.lr - 0.1) < 1e-9  # inside the window
+    c.on_epoch_begin(2)
+    c.on_batch_begin(0)
+    assert abs(inner.lr - 0.1) < 1e-9  # past end_epoch: frozen
+
+
+def test_lr_warmup_ramps_to_initial(hvd_tf, tf):
+    from horovod_tpu.tensorflow import callbacks as cb
+    inner = tf.train.Optimizer(lr=0.2)
+    c = cb.LearningRateWarmupCallback(warmup_epochs=2, steps_per_epoch=4)
+    c.set_model(_Model(inner))
+    c.on_train_begin()
+    # size()==1: the ramp multiplier is identically 1.0 at every batch
+    for epoch in range(2):
+        c.on_epoch_begin(epoch)
+        for b in range(4):
+            c.on_batch_begin(b)
+            assert abs(inner.lr - 0.2) < 1e-9
+            c.on_batch_end(b)
