@@ -38,6 +38,13 @@ def main():
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--d-model", type=int, default=64)
     ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--flash", action="store_true",
+                    help="Pallas flash kernels per ring block (fused "
+                         "forward AND backward). Per-shard seq len must "
+                         "divide by the kernel block (128, or the shard "
+                         "length itself when shorter, min multiple of 8) "
+                         "and head dim by 8 — otherwise the ring "
+                         "silently falls back to the jnp path")
     args = ap.parse_args()
 
     hvd.init()
@@ -52,7 +59,8 @@ def main():
     cfg = TransformerConfig(vocab_size=256, num_layers=args.layers,
                             num_heads=4, d_model=args.d_model,
                             d_ff=4 * args.d_model, dtype=dtype,
-                            sequence_axis="seq")
+                            sequence_axis="seq",
+                            flash_attention=args.flash)
     model = Transformer(cfg)
     # params are seq-layout independent: init with the dense clone
     init_model = Transformer(

@@ -271,11 +271,28 @@ def _flash_bwd_impl(q, k, v, g, out, lse, offsets, causal, sm_scale,
                     block_q, block_k, interpret):
     """Fused flash backward: dq pass then dk/dv pass, each streaming the
     other operand; memory is O(S * block), never O(S^2)."""
-    bh, sq, d = q.shape
-    skv = k.shape[1]
     # delta_i = sum_d dO * O — the softmax-jacobian row correction
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, Sq, 1]
+    return _flash_bwd_core(q, k, v, g, lse, delta, offsets, causal,
+                           sm_scale, block_q, block_k, interpret)
+
+
+def _flash_bwd_core(q, k, v, g, lse, delta, offsets, causal, sm_scale,
+                    block_q, block_k, interpret, out_dtype=None):
+    """The two backward kernel launches, with (lse, delta) supplied by
+    the caller. Ring attention calls this per rotated K/V block with the
+    globally-merged lse and the once-computed global delta — the
+    per-block partials then sum to the exact global-softmax gradient
+    (softmax over the union of blocks factorizes as p = exp(s - LSE)).
+    ``out_dtype`` lets accumulating callers request fp32 partials."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    # grads mirror their primal dtypes (custom_vjp aval contract) unless
+    # the caller wants uniform fp32 partials for accumulation
+    dq_dtype = out_dtype or q.dtype
+    dk_dtype = out_dtype or k.dtype
+    dv_dtype = out_dtype or v.dtype
     kw = dict(block_q=block_q, block_k=block_k, causal=causal,
               sm_scale=sm_scale)
     qspec = lambda b, i, j, *_: (b, i, 0)      # noqa: E731
@@ -297,7 +314,7 @@ def _flash_bwd_impl(q, k, v, g, out, lse, offsets, causal, sm_scale,
             out_specs=pl.BlockSpec((1, block_q, d), qspec),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), dq_dtype),
         interpret=interpret,
     )(offsets, q, k, v, g, lse, delta)
 
@@ -323,8 +340,8 @@ def _flash_bwd_impl(q, k, v, g, out, lse, offsets, causal, sm_scale,
             scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                             pltpu.VMEM((block_k, d), jnp.float32)],
         ),
-        out_shape=(jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((bh, skv, d), dk_dtype),
+                   jax.ShapeDtypeStruct((bh, skv, d), dv_dtype)),
         interpret=interpret,
     )(offsets, q, k, v, g, lse, delta)
     return dq, dk, dv
@@ -430,6 +447,42 @@ def flash_attention_with_lse(q, k, v, *, causal=True, sm_scale=None,
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     lse = lse.reshape(b, h, sq).transpose(0, 2, 1)  # [BH,Sq,1] -> [B,S,H]
     return out, lse
+
+
+def flash_attention_bwd_block(q, k, v, g, lse, delta, *, causal=True,
+                              sm_scale=None, q_offset=0, kv_offset=0,
+                              block_q=DEFAULT_BLOCK_Q,
+                              block_k=DEFAULT_BLOCK_K, interpret=None):
+    """Per-block fused backward for blockwise/ring composition: given
+    this rank's queries ``q`` [B,Sq,H,D], one rotated K/V block
+    [B,Skv,H,D], the upstream ``g`` = dO, the **globally merged**
+    ``lse`` [B,Sq,H] (from ``flash_attention_with_lse`` + lse merging)
+    and ``delta`` [B,Sq,H] = sum_d(dO * O) over the final output, runs
+    the fused dQ and dK/dV kernels and returns fp32 partials
+    ``(dq, dk, dv)`` for exactly this block's contribution. Summing the
+    partials over all blocks (rotating dk/dv with their K/V blocks
+    around the ring) reproduces the exact global-softmax gradient,
+    because p = exp(s - LSE) factorizes per block once LSE is global —
+    the ring backward never materializes an S x S score matrix
+    (parallel/ring.py ``_ring_attention_flash``)."""
+    to_bh, (b, sq, h, d), sm_scale, bq, bk, interpret = _prep(
+        q, k, v, sm_scale, block_q, block_k, interpret)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(kv_offset, jnp.int32)])
+
+    def rows_bh(x):  # [B,Sq,H] -> [BH,Sq,1]
+        return x.transpose(0, 2, 1).reshape(b * h, sq, 1)
+
+    dq, dk, dv = _flash_bwd_core(
+        to_bh(q), to_bh(k), to_bh(v), to_bh(g), rows_bh(lse),
+        rows_bh(delta), offsets, causal, sm_scale, bq, bk, interpret,
+        out_dtype=jnp.float32)
+
+    def from_bh(x, s):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    skv = k.shape[1]
+    return from_bh(dq, sq), from_bh(dk, skv), from_bh(dv, skv)
 
 
 def attention(q, k, v, *, causal=True, q_offset=0, kv_offset=0):

@@ -108,10 +108,18 @@ def ring_attention(q, k, v, axis_name, causal=True, q_positions=None,
 
 def _ring_attention_flash(q, k, v, axis_name, causal):
     """Ring attention whose per-block compute is the Pallas flash kernel
-    (forward); blocks merge by the standard log-sum-exp composition:
-    ``out = sum_j exp(lse_j - LSE) * out_j``. Backward differentiates the
-    jnp ring path instead (custom VJP) — same rematerialization policy as
-    the local flash kernel, and the collectives replay identically."""
+    in BOTH directions. Forward: blocks merge by the standard
+    log-sum-exp composition ``out = sum_j exp(lse_j - LSE) * out_j``.
+    Backward: a second ring pass runs the fused dQ/dKV kernels per
+    rotated K/V block against the globally-merged lse (saved from the
+    forward) and the once-computed ``delta = sum_d dO*O``; the dK/dV
+    partial accumulators rotate WITH their K/V blocks, so after n steps
+    each block's gradient arrives back at its home rank having collected
+    every rank's contribution. p = exp(s - LSE) factorizes per block
+    once LSE is global, so the summed partials equal the exact
+    global-softmax gradient while peak memory stays O(S_local * block)
+    — the dense jnp ring VJP it replaces materialized
+    S_local x S_local score blocks per step."""
     from horovod_tpu.ops import flash_attention as fa
 
     n = lax.axis_size(axis_name)
@@ -153,23 +161,50 @@ def _ring_attention_flash(q, k, v, axis_name, causal):
         kv_off0 = (me * sq).astype(jnp.int32)[None]
         o0 = jnp.zeros(q.shape, jnp.float32)
         lse0 = jnp.full((b, sq, h), _NEG_BIG, jnp.float32)
-        (_, _, _, out, _), _ = lax.scan(
+        (_, _, _, out, lse), _ = lax.scan(
             step, (k, v, kv_off0, o0, lse0), None, length=n)
-        return out.astype(q.dtype)
+        return out.astype(q.dtype), lse
 
     @jax.custom_vjp
     def run(q, k, v):
-        return fwd_impl(q, k, v)
+        out, _ = fwd_impl(q, k, v)
+        return out
 
     def run_fwd(q, k, v):
-        return fwd_impl(q, k, v), (q, k, v)
+        out, lse = fwd_impl(q, k, v)
+        return out, (q, k, v, out, lse)
 
     def run_bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name,
-                                              causal=causal), q, k, v)
-        return vjp(g)
+        q, k, v, out, lse = res
+        me = lax.axis_index(axis_name)
+        q_off = (me * sq).astype(jnp.int32)
+        # softmax-jacobian row correction against the FINAL output,
+        # shared by every block's partial backward: [B,Sq,H,D] -> [B,Sq,H]
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
+
+        def step(carry, _):
+            k_blk, v_blk, kv_off, dq_acc, dk_acc, dv_acc = carry
+            dq_p, dk_p, dv_p = fa.flash_attention_bwd_block(
+                q, k_blk, v_blk, g, lse, delta, causal=causal,
+                q_offset=q_off, kv_offset=kv_off[0])
+            dq_acc = dq_acc + dq_p
+            dk_acc = dk_acc + dk_p
+            dv_acc = dv_acc + dv_p
+            # dk/dv accumulators travel WITH their K/V block: after the
+            # full cycle they land home holding all ranks' contributions
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            kv_off = lax.ppermute(kv_off, axis_name, perm)
+            dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+            dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+            return (k_blk, v_blk, kv_off, dq_acc, dk_acc, dv_acc), None
+
+        kv_off0 = (me * sq).astype(jnp.int32)[None]
+        zeros = jnp.zeros((b, sq, h, d), jnp.float32)
+        (_, _, _, dq, dk, dv), _ = lax.scan(
+            step, (k, v, kv_off0, zeros, zeros, zeros), None, length=n)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
     run.defvjp(run_fwd, run_bwd)
     return run(q, k, v)

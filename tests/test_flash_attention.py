@@ -153,10 +153,50 @@ def test_ring_flash_matches_jnp_ring(n_devices):
     np.testing.assert_allclose(np.asarray(out_f),
                                np.asarray(_oracle(q, k, v)), atol=2e-5)
 
-    g_f = jax.grad(lambda q: jnp.sum(flash(q, k, v) ** 2))(q)
-    g_p = jax.grad(lambda q: jnp.sum(plain(q, k, v) ** 2))(q)
-    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_p),
-                               atol=5e-4)
+    # all three gradients: dq accumulates locally, dk/dv rotate home
+    # with their blocks — the fused ring backward must match the dense
+    # jnp-ring VJP exactly
+    g_f = jax.grad(lambda q, k, v: jnp.sum(flash(q, k, v) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    g_p = jax.grad(lambda q, k, v: jnp.sum(plain(q, k, v) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_p):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4)
+
+
+def test_ring_flash_backward_memory_bounded(n_devices):
+    """The fused ring backward must not materialize S_local x S_local
+    score blocks: compiled temp memory stays well under the dense
+    jnp-ring VJP's (which pays O(S_local^2) per scan step)."""
+    if n_devices < 4:
+        pytest.skip("needs 4+ devices")
+    from horovod_tpu.parallel import ring
+    n = 4
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:n]), ("seq",))
+    b, s, h, d = 1, 4 * 512, 2, 64  # S_local = 512
+
+    def shard(fn):
+        return _shard_ring(fn, mesh, n)
+
+    flash = shard(lambda q, k, v: ring.ring_attention(
+        q, k, v, "seq", causal=True, use_flash=True))
+    plain = shard(lambda q, k, v: ring.ring_attention(
+        q, k, v, "seq", causal=True))
+    q = jnp.zeros((b, s, h, d), jnp.float32)
+
+    def temp_bytes(f):
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(f(q, k, v) ** 2), argnums=(0, 1, 2)))
+        ma = g.lower(q, q, q).compile().memory_analysis()
+        return getattr(ma, "temp_size_in_bytes", None)
+
+    t_flash, t_plain = temp_bytes(flash), temp_bytes(plain)
+    if t_flash is None or t_plain is None:
+        pytest.skip("backend exposes no memory analysis")
+    # observed ~9x on the CPU backend; require at least 2x headroom so
+    # the assert is about the asymptotic class, not compiler noise
+    assert t_flash * 2 < t_plain, (t_flash, t_plain)
 
 
 def test_transformer_ring_flash_trains(hvd, n_devices):
