@@ -21,10 +21,15 @@ roofline analysis.
 
 Prints ONE JSON line with metric/value/unit/vs_baseline plus achieved
 TFLOP/s and MFU (XLA cost-analysis FLOPs over measured step time).
+``--repeats`` (default 5) runs that many timed windows and reports the
+MEDIAN with min/max spread — single-window numbers through the tunnel
+swung 3x between runs (BENCH_NOTES.md), so the median is the number
+that means something round over round.
 """
 
 import argparse
 import json
+import statistics
 
 import jax
 import optax
@@ -43,12 +48,19 @@ def main():
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--num-warmup", type=int, default=3)
     parser.add_argument("--num-iters", type=int, default=20)
+    parser.add_argument("--repeats", type=int, default=5,
+                        help="timed windows; the median is reported "
+                             "(tunnel/host noise made single windows "
+                             "swing 3x, BENCH_NOTES.md)")
     args = parser.parse_args()
+    if args.repeats < 1:
+        parser.error("--repeats must be >= 1")
 
     import horovod_tpu as hvd
     from horovod_tpu import training
-    from horovod_tpu.utils.benchmarks import (make_model, synthetic_batch,
-                                              timed_throughput)
+    from horovod_tpu.utils.benchmarks import (make_model,
+                                              repeat_throughput,
+                                              synthetic_batch)
 
     hvd.init()
     ndev = hvd.num_devices()
@@ -74,21 +86,38 @@ def main():
     except Exception:
         pass
 
-    img_per_sec, dt = timed_throughput(step, state, images, labels,
-                                       args.num_warmup, args.num_iters)
-    per_chip = img_per_sec / ndev
+    runs = repeat_throughput(step, state, images, labels,
+                             args.num_warmup, args.num_iters,
+                             args.repeats)
+    per_chip_runs = sorted(r[0] / ndev for r in runs)
+    per_chip = statistics.median(per_chip_runs)
+    dts = [r[1] for r in runs]
+    dt = statistics.median(dts)
     # cost_analysis is per-device already — no further /ndev
     achieved_tflops = flops_per_device_step * args.num_iters / dt / 1e12
     kind = jax.devices()[0].device_kind
-    # bf16 peaks for chips we might land on; 0 = unknown -> omit MFU
-    peaks = {"TPU v5 lite": 197.0, "TPU v5p": 459.0, "TPU v4": 275.0,
+    # bf16 peaks for chips we might land on; 0 = unknown -> omit MFU.
+    # Exact device_kind match first, then LONGEST matching prefix — a
+    # plain substring scan would let "TPU v4" (275) claim a
+    # "TPU v4 lite" (138) and misstate MFU by ~2x.
+    peaks = {"TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5p": 459.0,
+             "TPU v4 lite": 138.0, "TPU v4i": 138.0, "TPU v4": 275.0,
              "TPU v6 lite": 918.0, "TPU v6e": 918.0}
-    peak = next((v for k, v in peaks.items() if k in kind), 0.0)
+    peak = peaks.get(kind, 0.0)
+    if not peak:
+        for k in sorted(peaks, key=len, reverse=True):
+            if k in kind:
+                peak = peaks[k]
+                break
     result = {
         "metric": f"{args.model}_synthetic_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+        "repeats": args.repeats,
+        "img_per_sec_per_chip_min": round(per_chip_runs[0], 2),
+        "img_per_sec_per_chip_max": round(per_chip_runs[-1], 2),
+        "step_ms_median": round(1000 * dt / args.num_iters, 2),
     }
     if achieved_tflops:  # omit rather than publish 0.0 as a measurement
         result["achieved_tflops_per_chip"] = round(achieved_tflops, 1)

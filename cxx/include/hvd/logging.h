@@ -12,6 +12,9 @@
 #include <ctime>
 #include <sstream>
 #include <string>
+#include <strings.h>  // strcasecmp lives in POSIX <strings.h>, not
+                      // <cstring>; relying on glibc's transitive
+                      // include breaks stricter libcs
 
 namespace hvd {
 namespace logging {

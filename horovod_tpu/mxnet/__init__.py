@@ -119,19 +119,48 @@ def _make_distributed_trainer():
 DistributedTrainer = _make_distributed_trainer()
 
 
+def _append_broadcast_init(param, root_rank, name):
+    """Arm a deferred-init gluon parameter so that the moment the engine
+    materializes it (first forward shapes it and calls ``_init_impl``),
+    its freshly initialized value is broadcast from ``root_rank`` —
+    without this, each rank keeps its own random init and the model
+    silently diverges (reference ``mxnet/__init__.py:118-153``)."""
+    import types as _types
+
+    init_impl = param._init_impl  # bound method of this parameter
+
+    def wrapped_init_impl(self, *args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(self.data(), root_rank, name=f"bp.deferred.{name}")
+        data = self.data()
+        if hasattr(data, "wait_to_read"):
+            # block until the broadcast write-back lands before the
+            # engine's first use of the parameter
+            data.wait_to_read()
+
+    param._init_impl = _types.MethodType(wrapped_init_impl, param)
+
+
 def broadcast_parameters(params, root_rank=0):
     """Sync model parameters from root at startup (reference
     ``mxnet/__init__.py:118-153``). Accepts a plain ``dict`` of NDArrays
-    or a gluon ``ParameterDict``."""
+    or a gluon ``ParameterDict``. Deferred-init parameters (shape not
+    known yet) are armed to broadcast at materialization via
+    ``_append_broadcast_init``."""
+    deferred_exc = getattr(getattr(mx.gluon, "parameter", mx.gluon),
+                           "DeferredInitializationError", None)
     tensors = []
     if isinstance(params, dict):
         tensors = sorted(params.items())
     elif hasattr(params, "items"):  # gluon ParameterDict
         for name, p in sorted(params.items()):
-            try:
+            if deferred_exc is not None:
+                try:
+                    tensors.append((name, p.data()))
+                except deferred_exc:
+                    _append_broadcast_init(p, root_rank, name)
+            else:
                 tensors.append((name, p.data()))
-            except Exception:
-                pass  # deferred-init params are synced at first forward
     else:
         raise ValueError("invalid params type: " + str(type(params)))
     handles = [broadcast_async_(t, root_rank, name=f"bp.{name}")

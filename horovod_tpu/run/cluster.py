@@ -111,8 +111,11 @@ class SparkBackend(ClusterBackend):
     ``mapPartitionsWithIndex`` (reference ``spark/__init__.py:72-99``).
     Requires an active SparkContext; runs the Spark job on a thread and
     relies on Spark RPC encryption to protect the key in transit, as the
-    reference does. NOT exercised in-image (no pyspark here) — the
-    protocol underneath is covered by LocalProcessBackend tests."""
+    reference does. Exercised end-to-end against a stub SparkContext
+    (tests/test_cluster.py — threads for partitions, the same shape the
+    reference's test_spark.py gets from a local SparkSession); the full
+    subprocess protocol underneath is covered by LocalProcessBackend
+    tests."""
 
     def __init__(self, spark_context=None):
         if spark_context is None:

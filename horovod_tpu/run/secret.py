@@ -10,6 +10,15 @@ realized idiomatically for the HTTP KV/rendezvous plane.
 
 The key travels to workers the same way the reference distributes it: an
 environment variable (reference ``_HOROVOD_SECRET_KEY``), hex-encoded.
+
+Threat-model note: signatures cover ``(method, path, body)`` but carry
+no nonce or timestamp, so an on-path observer who captures a signed
+request can REPLAY it verbatim for the lifetime of the run (e.g.
+re-PUT a stale key/value). This matches the reference's guarantee level
+— its framed digests are equally replayable — and is acceptable because
+keys are per-run and the control plane is idempotent puts/gets; if a
+deployment needs replay resistance, fold a per-run random context string
+plus a monotonic counter into the signed message.
 """
 
 import hashlib

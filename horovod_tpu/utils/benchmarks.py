@@ -40,16 +40,33 @@ def synthetic_batch(global_batch, image_size, dtype=None, num_classes=1000,
     return images, labels
 
 
-def timed_throughput(step, state, images, labels, warmup, iters):
-    """img/s of ``step`` over the timed window (async dispatch, one
-    block at the end — the sequential state dependency makes the final
-    block cover every step)."""
-    for _ in range(warmup):
-        state, loss = step(state, images, labels)
+def repeat_throughput(step, state, images, labels, warmup, iters,
+                      repeats):
+    """``repeats`` back-to-back timed windows over a continuously
+    evolving state (donation-safe: the caller's state is consumed once
+    and threaded through), returning a list of ``(img_per_sec, dt)``.
+    Warmup runs only before the first window — later windows are warm by
+    construction. Each step consumes the previous state, so no two
+    executions are identical and the whole sequence really executes."""
+    runs = []
+    for r in range(repeats):
+        for _ in range(warmup if r == 0 else 0):
+            state, loss = step(state, images, labels)
+            jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = step(state, images, labels)
         jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step(state, images, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    return images.shape[0] * iters / dt, dt
+        dt = time.perf_counter() - t0
+        runs.append((images.shape[0] * iters / dt, dt))
+    return runs
+
+
+def timed_throughput(step, state, images, labels, warmup, iters):
+    """img/s of ``step`` over one timed window (async dispatch, one
+    block at the end — the sequential state dependency makes the final
+    block cover every step). The single-window view of
+    ``repeat_throughput`` so the timing discipline has exactly one
+    copy."""
+    return repeat_throughput(step, state, images, labels, warmup, iters,
+                             repeats=1)[0]

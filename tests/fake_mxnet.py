@@ -67,15 +67,36 @@ class Optimizer:
         return self.create_state(index, weight)
 
 
+class DeferredInitializationError(Exception):
+    """Raised by Parameter.data() before the engine materializes a
+    shape-deferred parameter (mirrors gluon's exception of the same
+    name)."""
+
+
 class Parameter:
-    def __init__(self, name, data, grad_req="write"):
+    def __init__(self, name, data=None, grad_req="write"):
         self.name = name
         self.grad_req = grad_req
+        if data is None:  # deferred init: shape unknown until forward
+            self._data = None
+            self._grad = None
+        else:
+            self._data = NDArray(data)
+            self._grad = NDArray(np.zeros_like(self._data.asnumpy()))
+
+    def data(self):
+        if self._data is None:
+            raise DeferredInitializationError(self.name)
+        return self._data
+
+    def _init_impl(self, data):
         self._data = NDArray(data)
         self._grad = NDArray(np.zeros_like(self._data.asnumpy()))
 
-    def data(self):
-        return self._data
+    def _finish_deferred_init(self, data):
+        """What the gluon engine does at first forward once shapes are
+        known: run the initializer through _init_impl."""
+        self._init_impl(data)
 
     def list_grad(self):
         return [self._grad]
@@ -120,8 +141,13 @@ def install():
     mx.gluon = types.ModuleType("mxnet.gluon")
     mx.gluon.Trainer = Trainer
     mx.gluon.Parameter = Parameter
+    mx.gluon.parameter = types.ModuleType("mxnet.gluon.parameter")
+    mx.gluon.parameter.Parameter = Parameter
+    mx.gluon.parameter.DeferredInitializationError = \
+        DeferredInitializationError
     mods = {"mxnet": mx, "mxnet.nd": mx.nd,
-            "mxnet.optimizer": mx.optimizer, "mxnet.gluon": mx.gluon}
+            "mxnet.optimizer": mx.optimizer, "mxnet.gluon": mx.gluon,
+            "mxnet.gluon.parameter": mx.gluon.parameter}
     for name, mod in mods.items():
         # None __spec__ breaks importlib.util.find_spec probes elsewhere
         mod.__spec__ = importlib.machinery.ModuleSpec(name, None)

@@ -66,3 +66,102 @@ def test_cluster_failure_propagates():
                        backend=LocalProcessBackend(
                            env={"JAX_PLATFORMS": "cpu"}),
                        start_timeout=120)
+
+
+# ---- SparkBackend against a stub SparkContext --------------------------
+
+class _FakeRDD:
+    """The three-call sliver of pyspark RDD that SparkBackend touches."""
+
+    def __init__(self, sc, n):
+        self._sc = sc
+        self._n = n
+        self._mapper = None
+
+    def mapPartitionsWithIndex(self, f):
+        self._mapper = f
+        return self
+
+    def collect(self):
+        import threading
+        if self._sc.fail_with is not None:
+            raise self._sc.fail_with
+        results = [None] * self._n
+        errors = []
+
+        def part(i):
+            try:
+                results[i] = list(self._mapper(i, iter(())))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors.append(e)
+
+        threads = [threading.Thread(target=part, args=(i,))
+                   for i in range(self._n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return [x for r in results for x in (r or [])]
+
+
+class _FakeSparkContext:
+    """range/mapPartitionsWithIndex/collect/cancelAllJobs, partitions in
+    threads — what the reference's test_spark.py fakes with a local
+    SparkSession."""
+
+    def __init__(self, fail_with=None):
+        self.fail_with = fail_with
+        self.cancelled = 0
+
+    def range(self, start, end, numSlices=None):
+        return _FakeRDD(self, end - start)
+
+    def cancelAllJobs(self):
+        self.cancelled += 1
+
+
+def test_spark_backend_end_to_end_with_stub_context():
+    from horovod_tpu.run.cluster import SparkBackend
+
+    def fn():
+        return "partition-ok"
+
+    sc = _FakeSparkContext()
+    results = run_on_cluster(fn, num_proc=2, backend=SparkBackend(sc),
+                             kv_host="127.0.0.1", kv_addr="127.0.0.1",
+                             start_timeout=120)
+    assert results == ["partition-ok", "partition-ok"]
+
+
+def test_spark_backend_propagates_job_failure():
+    """A failed Spark job surfaces through alive()/wait(): the driver's
+    liveness hook aborts the run instead of hanging on registrations."""
+    from horovod_tpu.run.cluster import SparkBackend
+
+    sc = _FakeSparkContext(fail_with=RuntimeError("stage lost"))
+    backend = SparkBackend(sc)
+    with pytest.raises(RuntimeError):
+        run_on_cluster(lambda: 0, num_proc=2, backend=backend,
+                       kv_host="127.0.0.1", kv_addr="127.0.0.1",
+                       start_timeout=30)
+    assert not backend.alive()
+    with pytest.raises(RuntimeError, match="stage lost"):
+        backend.wait()
+
+
+def test_spark_backend_cancel_cancels_all_jobs():
+    from horovod_tpu.run.cluster import SparkBackend
+
+    sc = _FakeSparkContext()
+    backend = SparkBackend(sc)
+    backend.cancel()
+    assert sc.cancelled == 1
+
+
+def test_spark_backend_requires_active_context():
+    from horovod_tpu.run.cluster import SparkBackend
+
+    with pytest.raises((RuntimeError, ImportError)):
+        SparkBackend(None)

@@ -118,3 +118,44 @@ def test_mxnet_trainer_and_broadcast():
     # -> effective mean grad 1.5 -> w = 0 - 1.5
     for r in results:
         np.testing.assert_allclose(r, np.full(3, -1.5), rtol=1e-6)
+
+
+def test_mxnet_deferred_init_broadcasts_at_materialization():
+    """A shape-deferred gluon parameter must be armed by
+    broadcast_parameters so that when the engine materializes it (first
+    forward), every rank ends up with root's values — not its own random
+    init (reference mxnet/__init__.py:118-153 _append_broadcast_init)."""
+    def fn():
+        import numpy as np
+
+        import fake_mxnet
+        mx = fake_mxnet.install()
+        import horovod_tpu.mxnet as hvd
+        hvd.init()
+
+        ready = mx.gluon.Parameter(
+            "ready", np.full(2, float(hvd.rank()), dtype=np.float32))
+        deferred = mx.gluon.Parameter("emb", data=None)  # shape unknown
+
+        class ParamDict:  # gluon's ParameterDict is not a dict subclass
+            def __init__(self, **kw):
+                self._p = kw
+
+            def items(self):
+                return self._p.items()
+
+        hvd.broadcast_parameters(
+            ParamDict(ready=ready, emb=deferred), root_rank=0)
+        # the ready param synced immediately; the deferred one is armed
+        before = ready.data().asnumpy().tolist()
+
+        # engine materializes at first forward with rank-divergent init
+        deferred._finish_deferred_init(
+            np.full((2, 3), 10.0 + hvd.rank(), dtype=np.float32))
+        after = deferred.data().asnumpy().tolist()
+        return before, after
+
+    results = api.run(fn, np=2, extra_env=_mx_env())
+    for before, after in results:
+        np.testing.assert_allclose(before, np.zeros(2))      # root's 0s
+        np.testing.assert_allclose(after, np.full((2, 3), 10.0))
