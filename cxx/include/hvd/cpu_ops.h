@@ -24,6 +24,53 @@ namespace hvd {
 enum class ReduceOp : uint8_t { SUM = 0, AVERAGE = 1, MIN = 2, MAX = 3,
                                 ADASUM = 4 };
 
+// A subgroup of global ranks forming its own ring (intra-host ring,
+// cross-host ring of chunk owners, ...). `members` lists global ranks in
+// ring order; `pos` is this rank's index.
+struct Group {
+  std::vector<int> members;
+  int pos = 0;
+  int size() const { return static_cast<int>(members.size()); }
+  int next() const { return members[(pos + 1) % size()]; }
+  int prev() const { return members[(pos - 1 + size()) % size()]; }
+};
+
+// Process placement across hosts (reference: the LOCAL/CROSS communicator
+// split that hierarchical NCCL/MPI ops ride, nccl_operations.cc:150,
+// MPIHierarchicalAllgather). Ranks are contiguous per host, the hvdrun
+// slot-allocation contract: rank = cross_rank * local_size + local_rank.
+struct Topology {
+  int rank = 0, size = 1;
+  int local_rank = 0, local_size = 1;
+  int cross_rank = 0, cross_size = 1;
+
+  // True when the topology describes a real 2-level split this rank's
+  // coordinates are consistent with.
+  bool hierarchical() const {
+    return local_size > 1 && cross_size > 1 &&
+           local_size * cross_size == size &&
+           rank == cross_rank * local_size + local_rank;
+  }
+  // Ranks on this host, ring-ordered.
+  Group LocalGroup() const {
+    Group grp;
+    for (int i = 0; i < local_size; ++i)
+      grp.members.push_back(cross_rank * local_size + i);
+    grp.pos = local_rank;
+    return grp;
+  }
+  // Same local_rank on every host, ring-ordered.
+  Group CrossGroup() const {
+    Group grp;
+    for (int j = 0; j < cross_size; ++j)
+      grp.members.push_back(j * local_size + local_rank);
+    grp.pos = cross_rank;
+    return grp;
+  }
+  // Host index a global rank lives on.
+  int HostOf(int r) const { return local_size > 0 ? r / local_size : 0; }
+};
+
 // In-place elementwise reduce: acc[i] = op(acc[i], other[i]).
 void ReduceInto(void* acc, const void* other, int64_t count, DataType dtype,
                 ReduceOp op);
@@ -49,6 +96,41 @@ Status RingReduceScatter(PeerMesh& mesh, int rank, int size, void* data,
 Status RingAllgatherv(PeerMesh& mesh, int rank, int size, const void* input,
                       const std::vector<int64_t>& counts, DataType dtype,
                       void* output);
+
+// Subgroup variants: the same ring schedules run over grp.members instead
+// of ranks [0, size).
+Status GroupRingAllreduce(PeerMesh& mesh, const Group& grp, void* data,
+                          int64_t count, DataType dtype, ReduceOp op);
+Status GroupRingReduceScatter(PeerMesh& mesh, const Group& grp, void* data,
+                              const std::vector<int64_t>& counts,
+                              DataType dtype, ReduceOp op, void* output);
+Status GroupRingAllgatherv(PeerMesh& mesh, const Group& grp,
+                           const void* input,
+                           const std::vector<int64_t>& counts,
+                           DataType dtype, void* output);
+// Star broadcast from grp.members[root_pos] within the subgroup.
+Status GroupBroadcast(PeerMesh& mesh, const Group& grp, void* data,
+                      int64_t count, DataType dtype, int root_pos);
+
+// 2-level allreduce (role of NCCLHierarchicalAllreduce,
+// nccl_operations.cc:150-346): intra-host ring reduce-scatter, then each
+// local rank runs the cross-host ring allreduce of its owned chunk (one
+// concurrent stream per local rank), then intra-host ring allgather.
+// Cross-host traffic per rank drops to ~2*count/local_size elements.
+// AVERAGE divides by `average_denom` (callers pass the active-rank count).
+Status HierarchicalAllreduce(PeerMesh& mesh, const Topology& topo,
+                             void* data, int64_t count, DataType dtype,
+                             ReduceOp op, int average_denom);
+
+// 2-level allgatherv (role of MPIHierarchicalAllgather,
+// mpi_operations.cc): intra-host allgatherv assembles each host's block,
+// host leaders (local_rank 0) exchange whole host blocks cross-host, then
+// the full result broadcasts intra-host. Only leaders move bytes across
+// hosts. `counts` is per GLOBAL rank; output is the rank-order concat.
+Status HierarchicalAllgatherv(PeerMesh& mesh, const Topology& topo,
+                              const void* input,
+                              const std::vector<int64_t>& counts,
+                              DataType dtype, void* output);
 
 // Star broadcast from root (in-place on non-roots).
 Status Broadcast(PeerMesh& mesh, int rank, int size, void* data,

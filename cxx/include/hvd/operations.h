@@ -56,6 +56,12 @@ int hvdc_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms,
 // shrink these in steady state). Returns 0 on success.
 int hvdc_control_bytes(int64_t* sent, int64_t* recvd);
 
+// Cumulative data-plane payload bytes this rank has sent to peers on the
+// same host vs other hosts (per the HOROVOD_LOCAL_*/CROSS_* topology) —
+// the evidence hierarchical collectives cut cross-host traffic. Returns
+// 0 on success.
+int hvdc_data_bytes(int64_t* local_bytes, int64_t* cross_bytes);
+
 }  // extern "C"
 
 #endif  // HVD_OPERATIONS_H

@@ -11,6 +11,7 @@
 #ifndef HVD_PEER_MESH_H
 #define HVD_PEER_MESH_H
 
+#include <atomic>
 #include <condition_variable>
 #include <map>
 #include <memory>
@@ -57,6 +58,12 @@ class PeerMesh {
   Status RingStep(int next, int prev, const void* send, size_t send_len,
                   void* recv, size_t recv_len);
 
+  // Cumulative payload bytes sent to `peer` (hierarchical-collective
+  // traffic accounting; the reference's NCCL layer has no equivalent
+  // introspection — this exists so tests can prove the intra/cross-host
+  // traffic split).
+  int64_t bytes_sent_to(int peer) const;
+
   void Shutdown();
 
  private:
@@ -67,6 +74,7 @@ class PeerMesh {
   std::unique_ptr<TcpServer> server_;
   std::vector<PeerInfo> roster_;
   std::map<int, std::unique_ptr<TcpConnection>> conns_;
+  std::unique_ptr<std::atomic<int64_t>[]> sent_bytes_;
   std::mutex mu_;
   std::condition_variable cv_;
   std::thread accept_thread_;

@@ -166,13 +166,26 @@ struct Chunks {
 
 }  // namespace
 
-Status RingAllreduce(PeerMesh& mesh, int rank, int size, void* data,
-                     int64_t count, DataType dtype, ReduceOp op) {
+namespace {
+
+Group TrivialGroup(int rank, int size) {
+  Group grp;
+  grp.members.resize(size);
+  for (int i = 0; i < size; ++i) grp.members[i] = i;
+  grp.pos = rank;
+  return grp;
+}
+
+}  // namespace
+
+Status GroupRingAllreduce(PeerMesh& mesh, const Group& grp, void* data,
+                          int64_t count, DataType dtype, ReduceOp op) {
+  int size = grp.size();
   if (size == 1) {
     return Status::OK();
   }
-  // ring allreduce = ring reduce-scatter (rank r ends owning reduced
-  // chunk r) + ring allgatherv of the owned chunks — one implementation
+  // ring allreduce = ring reduce-scatter (position p ends owning reduced
+  // chunk p) + ring allgatherv of the owned chunks — one implementation
   // of the N-1-step reduce schedule, shared with the standalone
   // reduce-scatter op.
   size_t esz = DataTypeSize(dtype);
@@ -180,20 +193,27 @@ Status RingAllreduce(PeerMesh& mesh, int rank, int size, void* data,
   std::vector<int64_t> counts(size);
   for (int i = 0; i < size; ++i) counts[i] = ch.len(i);
   ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
-  std::vector<uint8_t> own(counts[rank] * esz);
-  Status st = RingReduceScatter(mesh, rank, size, data, counts, dtype,
-                                wire_op, own.data());
+  std::vector<uint8_t> own(counts[grp.pos] * esz);
+  Status st = GroupRingReduceScatter(mesh, grp, data, counts, dtype,
+                                     wire_op, own.data());
   if (!st.ok()) return st;
-  st = RingAllgatherv(mesh, rank, size, own.data(), counts, dtype, data);
+  st = GroupRingAllgatherv(mesh, grp, own.data(), counts, dtype, data);
   if (!st.ok()) return st;
   if (op == ReduceOp::AVERAGE)
     ScaleInPlace(data, count, dtype, 1.0 / size);
   return Status::OK();
 }
 
-Status RingReduceScatter(PeerMesh& mesh, int rank, int size, void* data,
-                         const std::vector<int64_t>& counts, DataType dtype,
-                         ReduceOp op, void* output) {
+Status RingAllreduce(PeerMesh& mesh, int rank, int size, void* data,
+                     int64_t count, DataType dtype, ReduceOp op) {
+  return GroupRingAllreduce(mesh, TrivialGroup(rank, size), data, count,
+                            dtype, op);
+}
+
+Status GroupRingReduceScatter(PeerMesh& mesh, const Group& grp, void* data,
+                              const std::vector<int64_t>& counts,
+                              DataType dtype, ReduceOp op, void* output) {
+  int size = grp.size(), pos = grp.pos;
   size_t esz = DataTypeSize(dtype);
   uint8_t* bytes = static_cast<uint8_t*>(data);
   std::vector<int64_t> displs(size, 0);
@@ -203,14 +223,14 @@ Status RingReduceScatter(PeerMesh& mesh, int rank, int size, void* data,
     int64_t max_count = 0;
     for (int64_t c : counts) max_count = std::max(max_count, c);
     std::vector<uint8_t> tmp(max_count * esz);
-    int next = (rank + 1) % size;
-    int prev = (rank - 1 + size) % size;
-    // schedule shifted one chunk vs the allreduce phase so rank r ends
-    // owning chunk r (not r+1): step s sends chunk (r-s-1), reduces
-    // chunk (r-s-2); after N-1 steps the fully reduced chunk is r's own.
+    int next = grp.next();
+    int prev = grp.prev();
+    // schedule shifted one chunk vs the allreduce phase so position p ends
+    // owning chunk p (not p+1): step s sends chunk (p-s-1), reduces
+    // chunk (p-s-2); after N-1 steps the fully reduced chunk is p's own.
     for (int s = 0; s < size - 1; ++s) {
-      int send_c = (rank - s - 1 + 2 * size) % size;
-      int recv_c = (rank - s - 2 + 2 * size) % size;
+      int send_c = (pos - s - 1 + 2 * size) % size;
+      int recv_c = (pos - s - 2 + 2 * size) % size;
       Status st = mesh.RingStep(next, prev, bytes + displs[send_c] * esz,
                                 counts[send_c] * esz, tmp.data(),
                                 counts[recv_c] * esz);
@@ -219,26 +239,38 @@ Status RingReduceScatter(PeerMesh& mesh, int rank, int size, void* data,
                  dtype, op);
     }
   }
-  std::memcpy(output, bytes + displs[rank] * esz, counts[rank] * esz);
+  std::memcpy(output, bytes + displs[pos] * esz, counts[pos] * esz);
   if (op == ReduceOp::AVERAGE)
-    ScaleInPlace(output, counts[rank], dtype, 1.0 / size);
+    ScaleInPlace(output, counts[pos], dtype, 1.0 / size);
   return Status::OK();
 }
 
-Status RingAllgatherv(PeerMesh& mesh, int rank, int size, const void* input,
-                      const std::vector<int64_t>& counts, DataType dtype,
-                      void* output) {
+Status RingReduceScatter(PeerMesh& mesh, int rank, int size, void* data,
+                         const std::vector<int64_t>& counts, DataType dtype,
+                         ReduceOp op, void* output) {
+  return GroupRingReduceScatter(mesh, TrivialGroup(rank, size), data,
+                                counts, dtype, op, output);
+}
+
+Status GroupRingAllgatherv(PeerMesh& mesh, const Group& grp,
+                           const void* input,
+                           const std::vector<int64_t>& counts,
+                           DataType dtype, void* output) {
+  int size = grp.size(), pos = grp.pos;
   size_t esz = DataTypeSize(dtype);
   uint8_t* out = static_cast<uint8_t*>(output);
   std::vector<int64_t> displs(size, 0);
   for (int i = 1; i < size; ++i) displs[i] = displs[i - 1] + counts[i - 1];
-  std::memcpy(out + displs[rank] * esz, input, counts[rank] * esz);
+  // hierarchical phase 2 gathers in place: my block already sits at its
+  // output slot, so the self-copy is skipped
+  if (static_cast<const void*>(out + displs[pos] * esz) != input)
+    std::memcpy(out + displs[pos] * esz, input, counts[pos] * esz);
   if (size == 1) return Status::OK();
-  int next = (rank + 1) % size;
-  int prev = (rank - 1 + size) % size;
+  int next = grp.next();
+  int prev = grp.prev();
   for (int s = 0; s < size - 1; ++s) {
-    int send_b = (rank - s + size) % size;
-    int recv_b = (rank - s - 1 + size) % size;
+    int send_b = (pos - s + size) % size;
+    int recv_b = (pos - s - 1 + size) % size;
     Status st = mesh.RingStep(next, prev, out + displs[send_b] * esz,
                               counts[send_b] * esz,
                               out + displs[recv_b] * esz,
@@ -248,19 +280,99 @@ Status RingAllgatherv(PeerMesh& mesh, int rank, int size, const void* input,
   return Status::OK();
 }
 
-Status Broadcast(PeerMesh& mesh, int rank, int size, void* data,
-                 int64_t count, DataType dtype, int root) {
-  if (size == 1) return Status::OK();
+Status RingAllgatherv(PeerMesh& mesh, int rank, int size, const void* input,
+                      const std::vector<int64_t>& counts, DataType dtype,
+                      void* output) {
+  return GroupRingAllgatherv(mesh, TrivialGroup(rank, size), input, counts,
+                             dtype, output);
+}
+
+Status GroupBroadcast(PeerMesh& mesh, const Group& grp, void* data,
+                      int64_t count, DataType dtype, int root_pos) {
+  if (grp.size() == 1) return Status::OK();
   size_t nbytes = count * DataTypeSize(dtype);
-  if (rank == root) {
-    for (int i = 0; i < size; ++i) {
-      if (i == root) continue;
-      Status st = mesh.SendTo(i, data, nbytes);
+  if (grp.pos == root_pos) {
+    for (int i = 0; i < grp.size(); ++i) {
+      if (i == root_pos) continue;
+      Status st = mesh.SendTo(grp.members[i], data, nbytes);
       if (!st.ok()) return st;
     }
     return Status::OK();
   }
-  return mesh.RecvFrom(root, data, nbytes);
+  return mesh.RecvFrom(grp.members[root_pos], data, nbytes);
+}
+
+Status Broadcast(PeerMesh& mesh, int rank, int size, void* data,
+                 int64_t count, DataType dtype, int root) {
+  return GroupBroadcast(mesh, TrivialGroup(rank, size), data, count, dtype,
+                        root);
+}
+
+// ---- hierarchical (2-level) composites ---------------------------------
+
+Status HierarchicalAllreduce(PeerMesh& mesh, const Topology& topo,
+                             void* data, int64_t count, DataType dtype,
+                             ReduceOp op, int average_denom) {
+  size_t esz = DataTypeSize(dtype);
+  ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
+  Group local = topo.LocalGroup();
+  Chunks ch(count, local.size());
+  std::vector<int64_t> counts(local.size());
+  for (int i = 0; i < local.size(); ++i) counts[i] = ch.len(i);
+
+  // 1. intra-host reduce-scatter: local rank r ends owning the host-sum
+  //    of chunk r
+  std::vector<uint8_t> own(counts[topo.local_rank] * esz);
+  Status st = GroupRingReduceScatter(mesh, local, data, counts, dtype,
+                                     wire_op, own.data());
+  if (!st.ok()) return st;
+  // 2. cross-host allreduce of the owned chunk; every local rank drives
+  //    its own cross ring concurrently (disjoint peer sets)
+  st = GroupRingAllreduce(mesh, topo.CrossGroup(), own.data(),
+                          counts[topo.local_rank], dtype, wire_op);
+  if (!st.ok()) return st;
+  // 3. intra-host allgather of globally-reduced chunks
+  st = GroupRingAllgatherv(mesh, local, own.data(), counts, dtype, data);
+  if (!st.ok()) return st;
+  if (op == ReduceOp::AVERAGE && average_denom > 0)
+    ScaleInPlace(data, count, dtype, 1.0 / average_denom);
+  return Status::OK();
+}
+
+Status HierarchicalAllgatherv(PeerMesh& mesh, const Topology& topo,
+                              const void* input,
+                              const std::vector<int64_t>& counts,
+                              DataType dtype, void* output) {
+  size_t esz = DataTypeSize(dtype);
+  int L = topo.local_size, C = topo.cross_size;
+  std::vector<int64_t> displs(topo.size, 0);
+  for (int i = 1; i < topo.size; ++i) displs[i] = displs[i - 1] + counts[i - 1];
+  int64_t total = displs[topo.size - 1] + counts[topo.size - 1];
+  uint8_t* out = static_cast<uint8_t*>(output);
+
+  // 1. intra-host allgatherv straight into this host's (contiguous) block
+  //    of the output buffer
+  Group local = topo.LocalGroup();
+  std::vector<int64_t> lcounts(L);
+  for (int i = 0; i < L; ++i) lcounts[i] = counts[topo.cross_rank * L + i];
+  uint8_t* host_block = out + displs[topo.cross_rank * L] * esz;
+  Status st = GroupRingAllgatherv(mesh, local, input, lcounts, dtype,
+                                  host_block);
+  if (!st.ok()) return st;
+
+  // 2. host leaders exchange whole host blocks — the only cross-host
+  //    traffic, once per HOST instead of once per rank
+  if (topo.local_rank == 0) {
+    std::vector<int64_t> hcounts(C, 0);
+    for (int h = 0; h < C; ++h)
+      for (int i = 0; i < L; ++i) hcounts[h] += counts[h * L + i];
+    st = GroupRingAllgatherv(mesh, topo.CrossGroup(), host_block, hcounts,
+                             dtype, out);
+    if (!st.ok()) return st;
+  }
+  // 3. full result fans out intra-host from the leader (the shared-memory
+  //    window bcast of the reference, over loopback TCP here)
+  return GroupBroadcast(mesh, local, out, total, dtype, 0);
 }
 
 Status AllToAll(PeerMesh& mesh, int rank, int size, const void* input,

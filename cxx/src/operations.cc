@@ -102,6 +102,12 @@ class HandleManager {
 struct Global {
   int rank = 0;
   int size = 1;
+  // host placement (HOROVOD_LOCAL_*/CROSS_* launcher contract) + the
+  // hierarchical-collective gates (env defaults; the autotuner may flip
+  // the allreduce gate as a categorical dimension)
+  Topology topo;
+  bool hierarchical_allreduce = false;
+  bool hierarchical_allgather = false;
   std::unique_ptr<ControlPlane> control;
   std::unique_ptr<PeerMesh> mesh;
   TensorQueue queue;
@@ -198,12 +204,16 @@ void ExecuteFusedAllreduce(const Response& resp) {
                          fused.data(), total, resp.dtype);
   } else {
     // AVERAGE divides by the number of *contributing* (non-joined) ranks
-    ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
-    st = RingAllreduce(*g->mesh, g->rank, g->size, fused.data(), total,
-                       resp.dtype, wire_op);
-    if (st.ok() && op == ReduceOp::AVERAGE) {
-      int active = resp.active_ranks > 0 ? resp.active_ranks : g->size;
-      ScaleInPlace(fused.data(), total, resp.dtype, 1.0 / active);
+    int active = resp.active_ranks > 0 ? resp.active_ranks : g->size;
+    if (g->hierarchical_allreduce && g->topo.hierarchical()) {
+      st = HierarchicalAllreduce(*g->mesh, g->topo, fused.data(), total,
+                                 resp.dtype, op, active);
+    } else {
+      ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
+      st = RingAllreduce(*g->mesh, g->rank, g->size, fused.data(), total,
+                         resp.dtype, wire_op);
+      if (st.ok() && op == ReduceOp::AVERAGE)
+        ScaleInPlace(fused.data(), total, resp.dtype, 1.0 / active);
     }
   }
   g->timeline.ActivityEnd(resp.tensor_names[0]);
@@ -235,9 +245,16 @@ void ExecuteAllgather(const Response& resp) {
     total += dim0 * row;
   }
   std::vector<uint8_t> out(total * esz);
-  g->timeline.ActivityStart(e.name, "RING_ALLGATHER");
-  Status st = RingAllgatherv(*g->mesh, g->rank, g->size, e.data.data(),
-                             counts, resp.dtype, out.data());
+  Status st;
+  if (g->hierarchical_allgather && g->topo.hierarchical()) {
+    g->timeline.ActivityStart(e.name, "HIER_ALLGATHER");
+    st = HierarchicalAllgatherv(*g->mesh, g->topo, e.data.data(), counts,
+                                resp.dtype, out.data());
+  } else {
+    g->timeline.ActivityStart(e.name, "RING_ALLGATHER");
+    st = RingAllgatherv(*g->mesh, g->rank, g->size, e.data.data(),
+                        counts, resp.dtype, out.data());
+  }
   g->timeline.ActivityEnd(e.name);
   e.data = std::move(out);
   CompleteEntry(e, st);
@@ -751,6 +768,35 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
   ng->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
   ng->fusion_threshold =
       EnvInt("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+
+  // host placement: the hvdrun launcher exports LOCAL_*/CROSS_* with
+  // contiguous per-host ranks; absent or inconsistent values degrade to a
+  // flat single-host topology (hierarchical paths stay off)
+  {
+    Topology t;
+    t.rank = rank;
+    t.size = size;
+    t.local_size = static_cast<int>(EnvInt("HOROVOD_LOCAL_SIZE", size));
+    t.local_rank = static_cast<int>(
+        EnvInt("HOROVOD_LOCAL_RANK", rank % (t.local_size > 0
+                                                 ? t.local_size : 1)));
+    t.cross_size = static_cast<int>(
+        EnvInt("HOROVOD_CROSS_SIZE",
+               t.local_size > 0 ? size / t.local_size : 1));
+    t.cross_rank = static_cast<int>(
+        EnvInt("HOROVOD_CROSS_RANK",
+               t.local_size > 0 ? rank / t.local_size : 0));
+    ng->topo = t;
+    ng->hierarchical_allreduce =
+        EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE", false) && t.hierarchical();
+    ng->hierarchical_allgather =
+        EnvBool("HOROVOD_HIERARCHICAL_ALLGATHER", false) && t.hierarchical();
+    if (ng->hierarchical_allreduce || ng->hierarchical_allgather) {
+      HVD_LOG(INFO) << "hierarchical collectives on: local "
+                    << t.local_rank << "/" << t.local_size << ", cross "
+                    << t.cross_rank << "/" << t.cross_size;
+    }
+  }
   ng->cache = ResponseCache(
       static_cast<size_t>(EnvInt("HOROVOD_CACHE_CAPACITY", 1024)));
   ng->stall = StallInspector(
@@ -919,6 +965,22 @@ int hvdc_control_bytes(int64_t* sent, int64_t* recvd) {
   }
   if (sent) *sent = g->control->round_bytes_sent();
   if (recvd) *recvd = g->control->round_bytes_recv();
+  return 0;
+}
+
+int hvdc_data_bytes(int64_t* local_bytes, int64_t* cross_bytes) {
+  if (g == nullptr || !g->initialized.load()) return -1;
+  int64_t local = 0, cross = 0;
+  if (g->mesh) {
+    int my_host = g->topo.HostOf(g->rank);
+    for (int p = 0; p < g->size; ++p) {
+      if (p == g->rank) continue;
+      int64_t b = g->mesh->bytes_sent_to(p);
+      if (g->topo.HostOf(p) == my_host) local += b; else cross += b;
+    }
+  }
+  if (local_bytes) *local_bytes = local;
+  if (cross_bytes) *cross_bytes = cross;
   return 0;
 }
 

@@ -54,7 +54,17 @@ Status Progress(std::vector<Transfer>& transfers) {
   }
 }
 
-PeerMesh::PeerMesh(int rank, int size) : rank_(rank), size_(size) {}
+PeerMesh::PeerMesh(int rank, int size)
+    : rank_(rank),
+      size_(size),
+      sent_bytes_(new std::atomic<int64_t>[size > 0 ? size : 1]) {
+  for (int i = 0; i < size_; ++i) sent_bytes_[i].store(0);
+}
+
+int64_t PeerMesh::bytes_sent_to(int peer) const {
+  if (peer < 0 || peer >= size_) return 0;
+  return sent_bytes_[peer].load();
+}
 
 PeerMesh::~PeerMesh() { Shutdown(); }
 
@@ -144,6 +154,7 @@ Status PeerMesh::SendTo(int peer, const void* data, size_t len) {
   if (!s.ok()) return s;
   std::vector<Transfer> ts(1);
   ts[0] = {c->fd(), true, static_cast<const uint8_t*>(data), nullptr, len, 0};
+  sent_bytes_[peer].fetch_add(static_cast<int64_t>(len));
   return Progress(ts);
 }
 
@@ -165,6 +176,7 @@ Status PeerMesh::SendRecv(int peer, const void* send, size_t send_len,
   ts[0] = {c->fd(), true, static_cast<const uint8_t*>(send), nullptr,
            send_len, 0};
   ts[1] = {c->fd(), false, nullptr, static_cast<uint8_t*>(recv), recv_len, 0};
+  sent_bytes_[peer].fetch_add(static_cast<int64_t>(send_len));
   return Progress(ts);
 }
 
@@ -180,6 +192,7 @@ Status PeerMesh::RingStep(int next, int prev, const void* send,
            send_len, 0};
   ts[1] = {cp->fd(), false, nullptr, static_cast<uint8_t*>(recv), recv_len,
            0};
+  sent_bytes_[next].fetch_add(static_cast<int64_t>(send_len));
   return Progress(ts);
 }
 

@@ -82,6 +82,8 @@ def _load():
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     lib.hvdc_control_bytes.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.hvdc_data_bytes.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
     _lib = lib
     return lib
 
@@ -265,6 +267,18 @@ def control_bytes():
     if lib.hvdc_control_bytes(ctypes.byref(sent), ctypes.byref(recvd)) != 0:
         raise RuntimeError("native core is not initialized")
     return sent.value, recvd.value
+
+
+def data_bytes():
+    """Cumulative data-plane payload bytes (intra-host, cross-host) this
+    rank has sent, split by the HOROVOD_LOCAL_*/CROSS_* topology —
+    hierarchical collectives exist to shrink the cross-host share."""
+    lib = _load()
+    local = ctypes.c_int64(0)
+    cross = ctypes.c_int64(0)
+    if lib.hvdc_data_bytes(ctypes.byref(local), ctypes.byref(cross)) != 0:
+        raise RuntimeError("native core is not initialized")
+    return local.value, cross.value
 
 
 def autotune_state():

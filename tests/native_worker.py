@@ -285,6 +285,34 @@ def scenario_cache_invalidation(rank, size):
     np.testing.assert_allclose(out, np.full((2, 3), size * (size - 1) / 2.0))
 
 
+def scenario_hierarchy(rank, size):
+    """Fixed collective workload under a faked multi-host topology
+    (HOROVOD_LOCAL_SIZE set by the test); values must be exact whether the
+    hierarchical gates are on or off, and the final DATABYTES line lets
+    the test compare the intra/cross-host traffic split between the two
+    modes (reference role: nccl_operations.cc:150 hierarchical schedule +
+    MPIHierarchicalAllgather)."""
+    n = 64 * 1024  # 256 KB fp32: payload dominates barrier/control noise
+    for step in range(3):
+        x = np.arange(n, dtype=np.float32) + rank + step
+        out = core.allreduce(x, f"h.ar.{step}", op="average")
+        np.testing.assert_allclose(
+            out, np.arange(n, dtype=np.float32) + (size - 1) / 2.0 + step,
+            rtol=1e-6)
+    out = core.allreduce(np.full(33, rank + 1.0, dtype=np.float64),
+                         "h.sum", op="sum")
+    np.testing.assert_allclose(out, np.full(33, size * (size + 1) / 2.0))
+    # variable-size allgather: rank r contributes r+1 rows
+    xg = np.full((rank + 1, 512), rank, dtype=np.float32)
+    out = core.allgather(xg, "h.ag")
+    expected = np.concatenate(
+        [np.full((r + 1, 512), r, dtype=np.float32) for r in range(size)])
+    np.testing.assert_array_equal(out, expected)
+    core.barrier()
+    lb, cb = core.data_bytes()
+    print("DATABYTES", json.dumps([lb, cb]))
+
+
 def scenario_autotune(rank, size):
     """Run enough allreduces for the Bayesian-opt loop to exhaust its
     sample budget; every rank must end on the coordinator's winning

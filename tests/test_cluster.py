@@ -2,10 +2,24 @@
 run a real allreduce job through the cluster callback protocol with a
 fake (local-subprocess) cluster, and unit-check the rank grouping."""
 
+import os
+
 import numpy as np
 import pytest
 
 from horovod_tpu.run.cluster import LocalProcessBackend, run_on_cluster
+
+
+@pytest.fixture(autouse=True)
+def _isolate_environ():
+    """cluster_task mutates os.environ (correct inside a real executor
+    process); the stub SparkContext runs it in THIS process's threads, so
+    snapshot/restore the environment or rank-specific HOROVOD_* leaks
+    poison every later test that calls hvd.init()."""
+    snapshot = os.environ.copy()
+    yield
+    os.environ.clear()
+    os.environ.update(snapshot)
 
 
 def _make_train(scale):

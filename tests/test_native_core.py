@@ -26,8 +26,11 @@ def _free_port():
 
 def _run_workers(scenario, size, env_extra=None, timeout=90):
     port = _free_port()
-    env = dict(os.environ)
-    env.pop("HOROVOD_TIMELINE", None)
+    # drop any HOROVOD_* inherited from the pytest process (an earlier
+    # test may have initialized an adapter or leaked launcher vars) so a
+    # scenario's topology/tuning env is exactly env_extra
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("HOROVOD_")}
     env["JAX_PLATFORMS"] = "cpu"  # workers never need a device
     env.update(env_extra or {})
     procs = [
@@ -93,6 +96,42 @@ def test_autotune_converges_and_syncs(tmp_path):
     assert any(l.startswith("converged,") for l in lines)
     assert len([l for l in lines if not l.startswith(("sample", "converged"))
                 ]) >= 8
+
+
+def _cross_traffic(results):
+    import json as _json
+    local = cross = 0
+    for out, _ in results:
+        line = [l for l in out.splitlines() if l.startswith("DATABYTES ")][0]
+        lb, cb = _json.loads(line[len("DATABYTES "):])
+        local += lb
+        cross += cb
+    return local, cross
+
+
+def test_hierarchical_cuts_cross_host_traffic():
+    """Faked 2-host x 4-rank topology: the same workload run flat vs
+    hierarchical must produce identical values (asserted in the worker)
+    while the hierarchical schedule's cross-host bytes drop to about
+    1/local_size of the flat ring's total traffic (reference
+    nccl_operations.cc:150 schedule + MPIHierarchicalAllgather role)."""
+    topo = {"HOROVOD_LOCAL_SIZE": "4"}
+    flat = _run_workers("hierarchy", 8, env_extra=topo, timeout=180)
+    hier = _run_workers("hierarchy", 8, env_extra={
+        **topo,
+        "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+        "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+    }, timeout=180)
+    flat_local, flat_cross = _cross_traffic(flat)
+    hier_local, hier_cross = _cross_traffic(hier)
+    assert hier_cross < flat_cross, (
+        f"hierarchical cross-host traffic not reduced: "
+        f"hier={hier_cross} flat={flat_cross}")
+    local_size = 4
+    flat_total = flat_local + flat_cross
+    assert hier_cross <= flat_total / local_size * 1.25, (
+        f"cross-host bytes {hier_cross} not ~1/{local_size} of the flat "
+        f"ring's total {flat_total}")
 
 
 def test_join_uneven_ranks():
