@@ -26,16 +26,37 @@ struct PeerInfo {
   int data_port = 0;  // PeerMesh server port for bulk tensor traffic
 };
 
+// A rank's claimed host placement + requested hierarchical gates,
+// piggybacked on the hello handshake. The coordinator validates that the
+// claims form ONE consistent contiguous partition before any rank may run
+// a hierarchical schedule — a per-rank env decision could split the job
+// between the hierarchical and flat ring schedules and deadlock the data
+// plane.
+struct TopoClaim {
+  int local_rank = 0, local_size = 1;
+  int cross_rank = 0, cross_size = 1;
+  uint8_t want_gates = 0;  // bit0: hierarchical allreduce, bit1: allgather
+};
+
+// agreed gates broadcast with the roster:
+enum : uint8_t {
+  kTopoCapable = 1,        // placement is a consistent 2-level partition
+  kTopoHierAllreduce = 2,  // every rank requested + capable
+  kTopoHierAllgather = 4,
+};
+
 class ControlPlane {
  public:
   // rank 0 listens on control_port; others connect to coord_host.
   ControlPlane(int rank, int size, std::string coord_host, int control_port);
   ~ControlPlane();
 
-  // Exchange hellos; returns the full roster (host + data port per rank).
+  // Exchange hellos; returns the full roster (host + data port per rank)
+  // and the coordinator's agreed topology gates (kTopo* bits).
   // advertise_* describe this rank's PeerMesh endpoint.
   Status Initialize(const std::string& advertise_host, int advertise_port,
-                    std::vector<PeerInfo>& roster);
+                    const TopoClaim& topo, std::vector<PeerInfo>& roster,
+                    uint8_t& agreed_gates);
 
   int rank() const { return rank_; }
   int size() const { return size_; }

@@ -139,6 +139,10 @@ struct ResponseList {
   bool has_tuned_params = false;
   int64_t tuned_fusion_threshold = 0;
   double tuned_cycle_time_ms = 0;  // serialized bit-exactly
+  // categorical tuning decisions: every rank must run the same collective
+  // schedule and cache protocol in the same cycle
+  uint8_t tuned_hierarchical = 0;
+  uint8_t tuned_cache = 1;
 
   // steady-state decision: bit positions every (non-joined) rank
   // announced as cache hits — each rank reconstructs those responses

@@ -30,6 +30,17 @@ int hvdc_size();
 int hvdc_enqueue(int type, const char* name, const void* data,
                  const int64_t* shape, int ndim, int dtype, int op,
                  int root_rank, double prescale, double postscale);
+// Zero-copy variant: the core borrows `data` (no copy-in); for
+// allreduce/adasum/broadcast the result is written back into `data`
+// in place (no copy-out — hvdc_output_size reports 0). The caller must
+// keep the buffer alive and unmodified until the handle completes.
+// Reduce-scatter clobbers the buffer as ring scratch.
+int hvdc_enqueue_borrow(int type, const char* name, void* data,
+                        const int64_t* shape, int ndim, int dtype, int op,
+                        int root_rank, double prescale, double postscale);
+// Cumulative host-side memcpy bytes (enqueue copy-in, fusion staging,
+// output copy-out) — zero-copy paths exist to keep this flat.
+int64_t hvdc_copy_bytes();
 int hvdc_enqueue_join();
 
 // 0 = pending, 1 = done ok, -1 = done with error.
@@ -44,12 +55,14 @@ void hvdc_release(int handle);
 // Convenience: negotiated barrier across all ranks (blocking).
 int hvdc_barrier();
 
-// Autotuner introspection: current (possibly tuned) fusion threshold and
-// cycle time, plus coordinator-side sample count / convergence flag
-// (workers report samples=-1). Returns 1 when HOROVOD_AUTOTUNE is on,
-// 0 when off, -1 when the core is not initialized.
+// Autotuner introspection: current (possibly tuned) fusion threshold,
+// cycle time, and the categorical hierarchical-allreduce / cache gates,
+// plus coordinator-side sample count / convergence flag (workers report
+// samples=-1). Returns 1 when HOROVOD_AUTOTUNE is on, 0 when off, -1
+// when the core is not initialized.
 int hvdc_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms,
-                        int* samples, int* done);
+                        int* samples, int* done, int* hierarchical,
+                        int* cache_enabled);
 
 // Cumulative control-plane bytes this rank has sent/received in
 // negotiation rounds (the response-cache bitvector protocol exists to

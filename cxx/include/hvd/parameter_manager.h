@@ -1,12 +1,16 @@
 // Autotuner: online Bayesian optimization of the fusion threshold and
-// cycle time.
+// cycle time, plus the categorical hierarchical-allreduce and
+// response-cache gates.
 //
 // Role of the reference's horovod/common/parameter_manager.{h,cc}: score
-// each sample window as bytes/sec of allreduced payload, discard warmup
-// windows, propose the next (fusion_threshold, cycle_time) via GP expected
-// improvement, and converge on the best after a sample budget. The
-// coordinator runs it; tuned values ride to workers in the ResponseList
-// (reference: Controller::SynchronizeParameters).
+// each sample window as bytes/sec of payload moved, discard warmup
+// windows, propose the next parameter set via GP expected improvement
+// (categoricals ride the GP as 0/1 coordinates; the random phase cycles
+// every category combination the way the reference's
+// CategoricalParameterChunk walks its grid, parameter_manager.h:186-220),
+// and converge on the best after a sample budget. The coordinator runs
+// it; tuned values ride to workers in the ResponseList (reference:
+// Controller::SynchronizeParameters).
 #ifndef HVD_PARAMETER_MANAGER_H
 #define HVD_PARAMETER_MANAGER_H
 
@@ -29,10 +33,15 @@ class ParameterManager {
     double gp_noise = 1e-3;
     std::string log_file;
     uint64_t seed = 12345;
+    // categorical dims join the search only when the deployment can
+    // exercise them (a real multi-host topology / a cache at all)
+    bool tune_hierarchical = false;
+    bool tune_cache = false;
   };
 
   void Initialize(const Options& opts, int64_t fusion_threshold,
-                  double cycle_time_ms);
+                  double cycle_time_ms, bool hierarchical,
+                  bool cache_enabled);
   bool active() const { return opts_.enabled && !done_; }
   bool enabled() const { return opts_.enabled; }
   bool done() const { return done_; }
@@ -43,6 +52,8 @@ class ParameterManager {
 
   int64_t fusion_threshold() const { return current_fusion_; }
   double cycle_time_ms() const { return current_cycle_ms_; }
+  bool hierarchical() const { return current_hier_; }
+  bool cache_enabled() const { return current_cache_; }
   int64_t best_fusion_threshold() const { return best_fusion_; }
   double best_cycle_time_ms() const { return best_cycle_ms_; }
   double best_score() const { return best_score_; }
@@ -59,17 +70,23 @@ class ParameterManager {
   double time_acc_ = 0;
   int warmup_left_ = 0;
 
-  // normalized [0,1]^2 coords: x0 = log2(fusion)/26, x1 = cycle/25
+  // normalized coords: x0 = log2(fusion)/26, x1 = cycle/25,
+  // x2 = hierarchical (0/1), x3 = cache (0/1)
   std::vector<std::vector<double>> xs_;
   std::vector<double> ys_;
   GaussianProcess gp_;
 
   int64_t current_fusion_ = 64 << 20;
   double current_cycle_ms_ = 1.0;
+  bool current_hier_ = false;
+  bool current_cache_ = true;
   int64_t best_fusion_ = 64 << 20;
   double best_cycle_ms_ = 1.0;
+  bool best_hier_ = false;
+  bool best_cache_ = true;
   double best_score_ = -1;
   uint64_t rng_state_ = 12345;
+  size_t init_grid_ = 0;  // grid cell of the initial categorical config
   std::ofstream log_;
 };
 

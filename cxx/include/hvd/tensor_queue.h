@@ -29,6 +29,12 @@ struct TensorTableEntry {
   ReduceOp op = ReduceOp::SUM;
   double prescale = 1.0, postscale = 1.0;
   std::vector<uint8_t> data;    // input, reduced/gathered in place or grown
+  // Borrowed caller buffer (zero-copy enqueue, the reference's
+  // framework-tensor wrap, common.h:188-223): when set, ops read input
+  // here and same-shape results (allreduce/adasum/broadcast) are written
+  // back in place; `data` stays empty for those, so completion moves no
+  // bytes. The caller guarantees the buffer outlives the op.
+  uint8_t* ext = nullptr;
   int handle = -1;
 };
 

@@ -52,25 +52,58 @@ Status ControlPlane::EnsureConnected() {
 }
 
 Status ControlPlane::Initialize(const std::string& advertise_host,
-                                int advertise_port,
-                                std::vector<PeerInfo>& roster) {
+                                int advertise_port, const TopoClaim& topo,
+                                std::vector<PeerInfo>& roster,
+                                uint8_t& agreed_gates) {
   Status s = EnsureConnected();
   if (!s.ok()) return s;
-  // gather (host, data_port) to rank 0, broadcast the roster
+  // gather (host, data_port, topology claim) to rank 0, broadcast the
+  // roster + the coordinator's agreed gates
   Writer mine;
   mine.str(advertise_host);
   mine.i32(advertise_port);
+  mine.i32(topo.local_rank);
+  mine.i32(topo.local_size);
+  mine.i32(topo.cross_rank);
+  mine.i32(topo.cross_size);
+  mine.u8(topo.want_gates);
   std::vector<std::vector<uint8_t>> all;
   s = GatherFrames(mine.data(), all);
   if (!s.ok()) return s;
   std::vector<uint8_t> roster_bytes;
   if (is_coordinator()) {
     Writer w;
+    // every rank's claim must describe the SAME contiguous partition
+    // (rank = cross_rank * local_size + local_rank); any divergence —
+    // a missing env var on one host, non-contiguous placement — turns
+    // the hierarchical gates off for EVERYONE, never just for some.
+    bool capable = size_ > 1;
+    uint8_t want_and = 0x3;
+    int L = -1, C = -1;
     for (int i = 0; i < size_; ++i) {
       Reader r(all[i]);
       w.str(r.str());
       w.i32(r.i32());
+      TopoClaim c;
+      c.local_rank = r.i32();
+      c.local_size = r.i32();
+      c.cross_rank = r.i32();
+      c.cross_size = r.i32();
+      c.want_gates = r.u8();
+      want_and &= c.want_gates;
+      if (i == 0) { L = c.local_size; C = c.cross_size; }
+      if (c.local_size != L || c.cross_size != C || L < 2 || C < 2 ||
+          L * C != size_ ||
+          i != c.cross_rank * c.local_size + c.local_rank)
+        capable = false;
     }
+    uint8_t agreed = 0;
+    if (capable) {
+      agreed = kTopoCapable;
+      if (want_and & 0x1) agreed |= kTopoHierAllreduce;
+      if (want_and & 0x2) agreed |= kTopoHierAllgather;
+    }
+    w.u8(agreed);
     roster_bytes = w.take();
   }
   s = BcastFrame(roster_bytes, 0);
@@ -81,6 +114,7 @@ Status ControlPlane::Initialize(const std::string& advertise_host,
     roster[i].host = r.str();
     roster[i].data_port = r.i32();
   }
+  agreed_gates = r.u8();
   return Status::OK();
 }
 

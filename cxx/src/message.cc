@@ -137,6 +137,8 @@ std::vector<uint8_t> ResponseList::Serialize() const {
   w.u8(has_tuned_params ? 1 : 0);
   w.i64(tuned_fusion_threshold);
   w.i64(DoubleBits(tuned_cycle_time_ms));
+  w.u8(tuned_hierarchical);
+  w.u8(tuned_cache);
   WriteBits(w, cache_hits);
   w.i32(static_cast<int32_t>(cache_invalid.size()));
   for (uint32_t b : cache_invalid) w.i32(static_cast<int32_t>(b));
@@ -153,6 +155,8 @@ ResponseList ResponseList::Deserialize(const std::vector<uint8_t>& buf) {
   l.has_tuned_params = r.u8() != 0;
   l.tuned_fusion_threshold = r.i64();
   l.tuned_cycle_time_ms = BitsToDouble(r.i64());
+  l.tuned_hierarchical = r.u8();
+  l.tuned_cache = r.u8();
   l.cache_hits = ReadBits(r);
   int32_t ninv = r.i32();
   l.cache_invalid.reserve(ninv);

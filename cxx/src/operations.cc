@@ -103,9 +103,13 @@ struct Global {
   int rank = 0;
   int size = 1;
   // host placement (HOROVOD_LOCAL_*/CROSS_* launcher contract) + the
-  // hierarchical-collective gates (env defaults; the autotuner may flip
-  // the allreduce gate as a categorical dimension)
+  // hierarchical-collective gates. The gates and `hier_capable` are
+  // COORDINATOR-AGREED at the roster handshake (never per-rank env
+  // decisions — a split decision would run mismatched ring schedules and
+  // deadlock the data plane); the autotuner may flip the allreduce gate
+  // as a categorical dimension when capable.
   Topology topo;
+  bool hier_capable = false;
   bool hierarchical_allreduce = false;
   bool hierarchical_allgather = false;
   std::unique_ptr<ControlPlane> control;
@@ -122,6 +126,18 @@ struct Global {
   std::atomic<bool> initialized{false};
   double cycle_time_ms = 1.0;
   int64_t fusion_threshold = 64 * 1024 * 1024;
+  // response-cache gate: env-initialized, autotuner-flippable. Every rank
+  // applies the same value in the same cycle (it rides the ResponseList),
+  // which keeps the cache replicas in lockstep through flips.
+  bool cache_enabled = true;
+  // payload bytes the data plane moved this cycle (ALL op types — the
+  // autotuner's score numerator; reference parameter_manager scores
+  // allreduce+allgather+broadcast traffic alike)
+  std::atomic<int64_t> cycle_bytes{0};
+  // host-side memcpy accounting (enqueue copy-in, fusion staging, output
+  // copy-out) — the zero-copy borrow path exists to keep this at 0 for
+  // large single tensors; tests assert on it
+  std::atomic<int64_t> copied_bytes{0};
 
   // autotuner (coordinator scores cycles + proposes; tuned params ride
   // the ResponseList to workers — reference SynchronizeParameters).
@@ -168,6 +184,31 @@ void CompleteEntry(TensorTableEntry& e, const Status& s) {
     g->handles.MarkDone(e.handle, s, std::move(e.data));
 }
 
+// Input/in-place-result pointer: the borrowed caller buffer when the
+// entry was enqueued zero-copy, the owned staging vector otherwise.
+uint8_t* EntryPtr(TensorTableEntry& e) {
+  return e.ext != nullptr ? e.ext : e.data.data();
+}
+
+// The reduction schedule itself, shared by the fused and single-tensor
+// paths: runs in place on `buf`.
+Status RunAllreduce(Response::Type type, uint8_t* buf, int64_t total,
+                    DataType dtype, ReduceOp op, int active) {
+  if (type == Response::ADASUM)
+    return AdasumAllreduce(*g->mesh, *g->control, g->rank, g->size, buf,
+                           total, dtype);
+  // AVERAGE divides by the number of *contributing* (non-joined) ranks
+  if (g->hierarchical_allreduce)  // coordinator-agreed at init, never split
+    return HierarchicalAllreduce(*g->mesh, g->topo, buf, total, dtype, op,
+                                 active);
+  ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
+  Status st = RingAllreduce(*g->mesh, g->rank, g->size, buf, total, dtype,
+                            wire_op);
+  if (st.ok() && op == ReduceOp::AVERAGE)
+    ScaleInPlace(buf, total, dtype, 1.0 / active);
+  return st;
+}
+
 void ExecuteFusedAllreduce(const Response& resp) {
   size_t esz = DataTypeSize(resp.dtype);
   int64_t total = 0;
@@ -178,6 +219,29 @@ void ExecuteFusedAllreduce(const Response& resp) {
   for (size_t i = 0; i < resp.tensor_names.size(); ++i)
     have[i] = g->queue.Take(resp.tensor_names[i], entries[i]);
 
+  ReduceOp op = static_cast<ReduceOp>(resp.reduce_op);
+  int active = resp.active_ranks > 0 ? resp.active_ranks : g->size;
+  g->cycle_bytes.fetch_add(total * static_cast<int64_t>(esz));
+  const char* activity = resp.type == Response::ADASUM
+                             ? "ADASUM_ALLREDUCE" : "RING_ALLREDUCE";
+
+  // single-tensor fast path: reduce in place on the entry's own buffer
+  // (for a borrowed buffer that is the caller's memory — zero host
+  // copies, the role of the reference's zero-copy tensor wrap)
+  if (entries.size() == 1 && have[0]) {
+    TensorTableEntry& e = entries[0];
+    uint8_t* buf = EntryPtr(e);
+    if (e.prescale != 1.0)
+      ScaleInPlace(buf, total, resp.dtype, e.prescale);
+    g->timeline.ActivityStart(resp.tensor_names[0], activity);
+    Status st = RunAllreduce(resp.type, buf, total, resp.dtype, op, active);
+    g->timeline.ActivityEnd(resp.tensor_names[0]);
+    if (st.ok() && e.postscale != 1.0)
+      ScaleInPlace(buf, total, resp.dtype, e.postscale);
+    CompleteEntry(e, st);
+    return;
+  }
+
   // fusion buffer (reference FusionBufferManager + MemcpyInFusionBuffer) —
   // joined ranks contribute zeros (reference tensor_queue.h:39-41)
   std::vector<uint8_t> fused(total * esz, 0);
@@ -185,47 +249,31 @@ void ExecuteFusedAllreduce(const Response& resp) {
   for (size_t i = 0; i < entries.size(); ++i) {
     int64_t nbytes = resp.tensor_sizes[i] * esz;
     if (have[i]) {
+      uint8_t* src = EntryPtr(entries[i]);
       if (entries[i].prescale != 1.0)
-        ScaleInPlace(entries[i].data.data(), resp.tensor_sizes[i],
-                     resp.dtype, entries[i].prescale);
-      std::memcpy(fused.data() + off, entries[i].data.data(), nbytes);
+        ScaleInPlace(src, resp.tensor_sizes[i], resp.dtype,
+                     entries[i].prescale);
+      std::memcpy(fused.data() + off, src, nbytes);
+      g->copied_bytes.fetch_add(nbytes);
     }
     off += nbytes;
   }
 
-  ReduceOp op = static_cast<ReduceOp>(resp.reduce_op);
-
-  Status st;
-  g->timeline.ActivityStart(resp.tensor_names[0],
-                            resp.type == Response::ADASUM
-                                ? "ADASUM_ALLREDUCE" : "RING_ALLREDUCE");
-  if (resp.type == Response::ADASUM) {
-    st = AdasumAllreduce(*g->mesh, *g->control, g->rank, g->size,
-                         fused.data(), total, resp.dtype);
-  } else {
-    // AVERAGE divides by the number of *contributing* (non-joined) ranks
-    int active = resp.active_ranks > 0 ? resp.active_ranks : g->size;
-    if (g->hierarchical_allreduce && g->topo.hierarchical()) {
-      st = HierarchicalAllreduce(*g->mesh, g->topo, fused.data(), total,
-                                 resp.dtype, op, active);
-    } else {
-      ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
-      st = RingAllreduce(*g->mesh, g->rank, g->size, fused.data(), total,
-                         resp.dtype, wire_op);
-      if (st.ok() && op == ReduceOp::AVERAGE)
-        ScaleInPlace(fused.data(), total, resp.dtype, 1.0 / active);
-    }
-  }
+  g->timeline.ActivityStart(resp.tensor_names[0], activity);
+  Status st = RunAllreduce(resp.type, fused.data(), total, resp.dtype, op,
+                           active);
   g->timeline.ActivityEnd(resp.tensor_names[0]);
 
   off = 0;
   for (size_t i = 0; i < entries.size(); ++i) {
     int64_t nbytes = resp.tensor_sizes[i] * esz;
     if (have[i]) {
-      std::memcpy(entries[i].data.data(), fused.data() + off, nbytes);
+      uint8_t* dst = EntryPtr(entries[i]);
+      std::memcpy(dst, fused.data() + off, nbytes);
+      g->copied_bytes.fetch_add(nbytes);
       if (st.ok() && entries[i].postscale != 1.0)
-        ScaleInPlace(entries[i].data.data(), resp.tensor_sizes[i],
-                     resp.dtype, entries[i].postscale);
+        ScaleInPlace(dst, resp.tensor_sizes[i], resp.dtype,
+                     entries[i].postscale);
       CompleteEntry(entries[i], st);
     }
     off += nbytes;
@@ -245,14 +293,15 @@ void ExecuteAllgather(const Response& resp) {
     total += dim0 * row;
   }
   std::vector<uint8_t> out(total * esz);
+  g->cycle_bytes.fetch_add(total * static_cast<int64_t>(esz));
   Status st;
-  if (g->hierarchical_allgather && g->topo.hierarchical()) {
+  if (g->hierarchical_allgather) {  // coordinator-agreed at init
     g->timeline.ActivityStart(e.name, "HIER_ALLGATHER");
-    st = HierarchicalAllgatherv(*g->mesh, g->topo, e.data.data(), counts,
+    st = HierarchicalAllgatherv(*g->mesh, g->topo, EntryPtr(e), counts,
                                 resp.dtype, out.data());
   } else {
     g->timeline.ActivityStart(e.name, "RING_ALLGATHER");
-    st = RingAllgatherv(*g->mesh, g->rank, g->size, e.data.data(),
+    st = RingAllgatherv(*g->mesh, g->rank, g->size, EntryPtr(e),
                         counts, resp.dtype, out.data());
   }
   g->timeline.ActivityEnd(e.name);
@@ -263,8 +312,11 @@ void ExecuteAllgather(const Response& resp) {
 void ExecuteBroadcast(const Response& resp) {
   TensorTableEntry e;
   if (!g->queue.Take(resp.tensor_names[0], e)) return;
+  int64_t bc_bytes =
+      resp.tensor_sizes[0] * static_cast<int64_t>(DataTypeSize(resp.dtype));
+  g->cycle_bytes.fetch_add(bc_bytes);
   g->timeline.ActivityStart(e.name, "BROADCAST");
-  Status st = Broadcast(*g->mesh, g->rank, g->size, e.data.data(),
+  Status st = Broadcast(*g->mesh, g->rank, g->size, EntryPtr(e),
                         resp.tensor_sizes[0], resp.dtype, e.root_rank);
   g->timeline.ActivityEnd(e.name);
   CompleteEntry(e, st);
@@ -281,9 +333,12 @@ void ExecuteAlltoall(const Response& resp) {
     CompleteEntry(e, st);
     return;
   }
-  std::vector<uint8_t> out(e.data.size());
+  int64_t nbytes =
+      e.shape.num_elements() * static_cast<int64_t>(DataTypeSize(resp.dtype));
+  std::vector<uint8_t> out(nbytes);
+  g->cycle_bytes.fetch_add(nbytes);
   g->timeline.ActivityStart(e.name, "ALLTOALL");
-  st = AllToAll(*g->mesh, g->rank, g->size, e.data.data(), count / g->size,
+  st = AllToAll(*g->mesh, g->rank, g->size, EntryPtr(e), count / g->size,
                 resp.dtype, out.data());
   g->timeline.ActivityEnd(e.name);
   e.data = std::move(out);
@@ -306,13 +361,17 @@ void ExecuteReduceScatter(const Response& resp) {
     counts[i] = (base + (i < rem ? 1 : 0)) * row;
 
   if (e.prescale != 1.0)
-    ScaleInPlace(e.data.data(), e.shape.num_elements(), resp.dtype,
+    ScaleInPlace(EntryPtr(e), e.shape.num_elements(), resp.dtype,
                  e.prescale);
   ReduceOp op = static_cast<ReduceOp>(resp.reduce_op);
   ReduceOp wire_op = (op == ReduceOp::AVERAGE) ? ReduceOp::SUM : op;
   std::vector<uint8_t> out(counts[g->rank] * esz);
+  g->cycle_bytes.fetch_add(
+      e.shape.num_elements() * static_cast<int64_t>(esz));
   g->timeline.ActivityStart(e.name, "RING_REDUCESCATTER");
-  Status st = RingReduceScatter(*g->mesh, g->rank, g->size, e.data.data(),
+  // the input buffer is clobbered as ring scratch (borrowed buffers too —
+  // in-place reduce-scatter semantics)
+  Status st = RingReduceScatter(*g->mesh, g->rank, g->size, EntryPtr(e),
                                 counts, resp.dtype, wire_op, out.data());
   g->timeline.ActivityEnd(e.name);
   if (st.ok() && op == ReduceOp::AVERAGE) {
@@ -439,6 +498,21 @@ ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
     }
     if (contributors == 0) all_and.assign(words, 0);
 
+    // cache tuned off: a worker one cycle behind the flip may still
+    // announce bits — evict them immediately so its pending tensors
+    // renegotiate in full next cycle instead of waiting out the
+    // stale-bit watchdog
+    if (!g->cache_enabled) {
+      for (size_t w = 0; w < words; ++w) {
+        for (uint64_t word = any_or[w]; word;) {
+          int b = __builtin_ctzll(word);
+          word &= word - 1;
+          invalid.insert(static_cast<uint32_t>(w * 64 + b));
+        }
+      }
+      all_and.assign(words, 0);
+    }
+
     // stale-hit watchdog: a bit some (not all) ranks keep announcing
     // must eventually renegotiate in full so the stall inspector can
     // name the missing ranks
@@ -533,26 +607,14 @@ ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
     rl.has_tuned_params = true;
     rl.tuned_fusion_threshold = g->pm.fusion_threshold();
     rl.tuned_cycle_time_ms = g->pm.cycle_time_ms();
+    rl.tuned_hierarchical = g->pm.hierarchical() ? 1 : 0;
+    rl.tuned_cache = g->pm.cache_enabled() ? 1 : 0;
     g->fusion_threshold = g->pm.fusion_threshold();
     g->cycle_time_ms = g->pm.cycle_time_ms();
+    g->hierarchical_allreduce = g->pm.hierarchical() && g->hier_capable;
+    g->cache_enabled = g->pm.cache_enabled();
   }
   return rl;
-}
-
-// Payload bytes a cycle's executed responses move through the data plane
-// (the autotuner's score numerator, reference parameter_manager score =
-// bytes/sec over sample windows).
-int64_t ResponsePayloadBytes(const std::vector<Response>& responses) {
-  int64_t bytes = 0;
-  for (const auto& r : responses) {
-    if (r.type != Response::ALLREDUCE && r.type != Response::ADASUM &&
-        r.type != Response::REDUCESCATTER)
-      continue;
-    int64_t elems = 0;
-    for (int64_t c : r.tensor_sizes) elems += c;
-    bytes += elems * static_cast<int64_t>(DataTypeSize(r.dtype));
-  }
-  return bytes;
 }
 
 bool IsCacheable(Response::Type t) {
@@ -595,8 +657,11 @@ std::vector<Response> BuildExecutionList(ResponseList& rl) {
   }
   // 3. full responses seed the replica for future hit cycles
   for (Response& r : rl.responses) {
-    if (g->size > 1 && r.error_message.empty() && IsCacheable(r.type) &&
-        r.type != Response::BARRIER) {
+    // replica Put is gated on the SAME tuned cache flag on every rank
+    // (adopted from this cycle's ResponseList), so flips keep replicas
+    // identical
+    if (g->size > 1 && g->cache_enabled && r.error_message.empty() &&
+        IsCacheable(r.type) && r.type != Response::BARRIER) {
       for (size_t i = 0; i < r.tensor_names.size(); ++i) {
         const std::string& name = r.tensor_names[i];
         Response single;
@@ -648,7 +713,7 @@ bool RunLoopOnce() {
   for (auto& q : popped) {
     // steady-state split: identical-parameter repeats are announced as
     // a cache bit; everything else goes the full negotiation path
-    if (g->size > 1 && q.type != Request::BARRIER &&
+    if (g->size > 1 && g->cache_enabled && q.type != Request::BARRIER &&
         g->cache.Cached(q) == ResponseCache::CacheState::HIT) {
       g->cache_pending.emplace(q.tensor_name, q);
       continue;
@@ -696,6 +761,9 @@ bool RunLoopOnce() {
       std::lock_guard<std::mutex> lock(g->tune_mu);
       g->fusion_threshold = rl.tuned_fusion_threshold;
       g->cycle_time_ms = rl.tuned_cycle_time_ms;
+      g->hierarchical_allreduce =
+          rl.tuned_hierarchical != 0 && g->hier_capable;
+      g->cache_enabled = rl.tuned_cache != 0;
     }
   }
 
@@ -717,7 +785,7 @@ bool RunLoopOnce() {
     double elapsed =
         std::chrono::duration<double>(now - g->last_cycle_tp).count();
     g->last_cycle_tp = now;
-    int64_t bytes = ResponsePayloadBytes(exec);
+    int64_t bytes = g->cycle_bytes.exchange(0);
     if (bytes > 0) {
       std::lock_guard<std::mutex> lock(g->tune_mu);
       g->pm.Update(bytes, elapsed);
@@ -787,18 +855,10 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
         EnvInt("HOROVOD_CROSS_RANK",
                t.local_size > 0 ? rank / t.local_size : 0));
     ng->topo = t;
-    ng->hierarchical_allreduce =
-        EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE", false) && t.hierarchical();
-    ng->hierarchical_allgather =
-        EnvBool("HOROVOD_HIERARCHICAL_ALLGATHER", false) && t.hierarchical();
-    if (ng->hierarchical_allreduce || ng->hierarchical_allgather) {
-      HVD_LOG(INFO) << "hierarchical collectives on: local "
-                    << t.local_rank << "/" << t.local_size << ", cross "
-                    << t.cross_rank << "/" << t.cross_size;
-    }
   }
-  ng->cache = ResponseCache(
-      static_cast<size_t>(EnvInt("HOROVOD_CACHE_CAPACITY", 1024)));
+  int64_t cache_capacity = EnvInt("HOROVOD_CACHE_CAPACITY", 1024);
+  ng->cache = ResponseCache(static_cast<size_t>(cache_capacity));
+  ng->cache_enabled = cache_capacity > 0;
   ng->stall = StallInspector(
       EnvDouble("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
       EnvDouble("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0));
@@ -815,9 +875,19 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
     ng->control = std::make_unique<ControlPlane>(
         rank, size, coord_host ? coord_host : "127.0.0.1", coord_port);
     std::vector<PeerInfo> roster;
+    TopoClaim claim;
+    claim.local_rank = ng->topo.local_rank;
+    claim.local_size = ng->topo.local_size;
+    claim.cross_rank = ng->topo.cross_rank;
+    claim.cross_size = ng->topo.cross_size;
+    if (EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE", false))
+      claim.want_gates |= 0x1;
+    if (EnvBool("HOROVOD_HIERARCHICAL_ALLGATHER", false))
+      claim.want_gates |= 0x2;
+    uint8_t agreed = 0;
     s = ng->control->Initialize(
         advertise_host ? advertise_host : "127.0.0.1", ng->mesh->port(),
-        roster);
+        claim, roster, agreed);
     if (!s.ok()) {
       HVD_LOG(ERROR) << "control-plane handshake failed: " << s.reason();
       ng->last_error = s.reason();
@@ -825,6 +895,15 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
       return 1;
     }
     ng->mesh->SetRoster(std::move(roster));
+    ng->hier_capable = (agreed & kTopoCapable) != 0;
+    ng->hierarchical_allreduce = (agreed & kTopoHierAllreduce) != 0;
+    ng->hierarchical_allgather = (agreed & kTopoHierAllgather) != 0;
+    if (ng->hierarchical_allreduce || ng->hierarchical_allgather) {
+      HVD_LOG(INFO) << "hierarchical collectives agreed on: local "
+                    << ng->topo.local_rank << "/" << ng->topo.local_size
+                    << ", cross " << ng->topo.cross_rank << "/"
+                    << ng->topo.cross_size;
+    }
     HVD_LOG(INFO) << "control plane up (coordinator " << coord_host << ":"
                   << coord_port << ", mesh port " << ng->mesh->port()
                   << ")";
@@ -848,7 +927,12 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
         EnvInt("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20));
     po.gp_noise =
         EnvDouble("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8);
-    ng->pm.Initialize(po, ng->fusion_threshold, ng->cycle_time_ms);
+    // categorical dims (reference parameter_manager.h:186-220): only
+    // searchable when the deployment can exercise them
+    po.tune_hierarchical = ng->hier_capable;
+    po.tune_cache = ng->cache_enabled;
+    ng->pm.Initialize(po, ng->fusion_threshold, ng->cycle_time_ms,
+                      ng->hierarchical_allreduce, ng->cache_enabled);
     ng->last_cycle_tp = std::chrono::steady_clock::now();
   }
 
@@ -879,9 +963,10 @@ int hvdc_is_initialized() {
 int hvdc_rank() { return g ? g->rank : -1; }
 int hvdc_size() { return g ? g->size : -1; }
 
-int hvdc_enqueue(int type, const char* name, const void* data,
-                 const int64_t* shape, int ndim, int dtype, int op,
-                 int root_rank, double prescale, double postscale) {
+static int EnqueueImpl(int type, const char* name, const void* data,
+                       const int64_t* shape, int ndim, int dtype, int op,
+                       int root_rank, double prescale, double postscale,
+                       bool borrow) {
   if (g == nullptr || !g->initialized.load()) {
     if (g) g->last_error = "horovod_tpu core is not initialized";
     return -1;
@@ -896,8 +981,19 @@ int hvdc_enqueue(int type, const char* name, const void* data,
   e.prescale = prescale;
   e.postscale = postscale;
   size_t nbytes = e.shape.num_elements() * DataTypeSize(e.dtype);
-  e.data.resize(nbytes);
-  if (data != nullptr) std::memcpy(e.data.data(), data, nbytes);
+  if (borrow && data != nullptr) {
+    // zero-copy: ops read — and for allreduce/adasum/broadcast write —
+    // the caller's buffer directly; the caller keeps it alive until the
+    // handle completes (the reference's framework-tensor wrap,
+    // common.h:188-223)
+    e.ext = static_cast<uint8_t*>(const_cast<void*>(data));
+  } else {
+    e.data.resize(nbytes);
+    if (data != nullptr) {
+      std::memcpy(e.data.data(), data, nbytes);
+      g->copied_bytes.fetch_add(static_cast<int64_t>(nbytes));
+    }
+  }
   e.handle = g->handles.Allocate();
   int handle = e.handle;
 
@@ -918,6 +1014,24 @@ int hvdc_enqueue(int type, const char* name, const void* data,
     g->handles.MarkDone(handle, s);
   }
   return handle;
+}
+
+int hvdc_enqueue(int type, const char* name, const void* data,
+                 const int64_t* shape, int ndim, int dtype, int op,
+                 int root_rank, double prescale, double postscale) {
+  return EnqueueImpl(type, name, data, shape, ndim, dtype, op, root_rank,
+                     prescale, postscale, /*borrow=*/false);
+}
+
+int hvdc_enqueue_borrow(int type, const char* name, void* data,
+                        const int64_t* shape, int ndim, int dtype, int op,
+                        int root_rank, double prescale, double postscale) {
+  return EnqueueImpl(type, name, data, shape, ndim, dtype, op, root_rank,
+                     prescale, postscale, /*borrow=*/true);
+}
+
+int64_t hvdc_copy_bytes() {
+  return (g != nullptr) ? g->copied_bytes.load() : 0;
 }
 
 int hvdc_enqueue_join() {
@@ -949,7 +1063,11 @@ int64_t hvdc_output_size(int handle) {
 }
 
 int hvdc_copy_output(int handle, void* dst) {
-  return (g && g->handles.CopyOutput(handle, dst)) ? 0 : 1;
+  if (g == nullptr) return 1;
+  int64_t n = g->handles.OutputSize(handle);
+  if (!g->handles.CopyOutput(handle, dst)) return 1;
+  if (n > 0) g->copied_bytes.fetch_add(n);
+  return 0;
 }
 
 void hvdc_release(int handle) {
@@ -985,11 +1103,14 @@ int hvdc_data_bytes(int64_t* local_bytes, int64_t* cross_bytes) {
 }
 
 int hvdc_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms,
-                        int* samples, int* done) {
+                        int* samples, int* done, int* hierarchical,
+                        int* cache_enabled) {
   if (g == nullptr || !g->initialized.load()) return -1;
   std::lock_guard<std::mutex> lock(g->tune_mu);
   if (fusion_threshold) *fusion_threshold = g->fusion_threshold;
   if (cycle_time_ms) *cycle_time_ms = g->cycle_time_ms;
+  if (hierarchical) *hierarchical = g->hierarchical_allreduce ? 1 : 0;
+  if (cache_enabled) *cache_enabled = g->cache_enabled ? 1 : 0;
   // sample/convergence progress is coordinator-side knowledge; workers
   // report -1 samples and infer convergence from the adopted values
   bool coord = g->pm.enabled();

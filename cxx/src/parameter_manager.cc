@@ -12,33 +12,44 @@ constexpr double kMinLogFusion = 16.0;  // 64 KB
 constexpr double kMaxCycleMs = 25.0;
 constexpr double kMinCycleMs = 0.5;
 
-std::vector<double> Encode(int64_t fusion, double cycle_ms) {
+std::vector<double> Encode(int64_t fusion, double cycle_ms, bool hier,
+                           bool cache) {
   double lf = std::log2(static_cast<double>(fusion < 1 ? 1 : fusion));
   return {(lf - kMinLogFusion) / (kMaxLogFusion - kMinLogFusion),
-          (cycle_ms - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs)};
+          (cycle_ms - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs),
+          hier ? 1.0 : 0.0, cache ? 1.0 : 0.0};
 }
 
-void Decode(const std::vector<double>& x, int64_t& fusion,
-            double& cycle_ms) {
+void Decode(const std::vector<double>& x, int64_t& fusion, double& cycle_ms,
+            bool& hier, bool& cache) {
   double lf = kMinLogFusion + x[0] * (kMaxLogFusion - kMinLogFusion);
   fusion = static_cast<int64_t>(std::pow(2.0, lf));
   cycle_ms = kMinCycleMs + x[1] * (kMaxCycleMs - kMinCycleMs);
+  hier = x[2] >= 0.5;
+  cache = x[3] >= 0.5;
 }
 
 }  // namespace
 
 void ParameterManager::Initialize(const Options& opts,
                                   int64_t fusion_threshold,
-                                  double cycle_time_ms) {
+                                  double cycle_time_ms, bool hierarchical,
+                                  bool cache_enabled) {
   opts_ = opts;
   gp_ = GaussianProcess(0.3, opts.gp_noise);
   current_fusion_ = best_fusion_ = fusion_threshold;
   current_cycle_ms_ = best_cycle_ms_ = cycle_time_ms;
+  current_hier_ = best_hier_ = hierarchical;
+  current_cache_ = best_cache_ = cache_enabled;
+  // the initial config occupies one cell of the 2x2 categorical grid;
+  // the random-phase proposals walk the OTHER cells starting after it
+  init_grid_ = (hierarchical ? 2u : 0u) | (cache_enabled ? 1u : 0u);
   warmup_left_ = opts.warmup_samples;
   rng_state_ = opts.seed;
   if (!opts.log_file.empty() && opts.enabled) {
     log_.open(opts.log_file, std::ios::out | std::ios::trunc);
-    log_ << "sample,fusion_threshold,cycle_time_ms,score_bytes_per_sec\n";
+    log_ << "sample,fusion_threshold,cycle_time_ms,hierarchical,cache,"
+            "score_bytes_per_sec\n";
   }
 }
 
@@ -67,24 +78,31 @@ bool ParameterManager::Update(int64_t bytes, double elapsed_sec) {
     return false;
   }
 
-  xs_.push_back(Encode(current_fusion_, current_cycle_ms_));
+  xs_.push_back(Encode(current_fusion_, current_cycle_ms_, current_hier_,
+                       current_cache_));
   ys_.push_back(score);
   if (log_.is_open()) {
     log_ << ys_.size() << "," << current_fusion_ << ","
-         << current_cycle_ms_ << "," << score << "\n";
+         << current_cycle_ms_ << "," << (current_hier_ ? 1 : 0) << ","
+         << (current_cache_ ? 1 : 0) << "," << score << "\n";
     log_.flush();
   }
   if (score > best_score_) {
     best_score_ = score;
     best_fusion_ = current_fusion_;
     best_cycle_ms_ = current_cycle_ms_;
+    best_hier_ = current_hier_;
+    best_cache_ = current_cache_;
   }
   if (static_cast<int>(ys_.size()) >= opts_.max_samples) {
     current_fusion_ = best_fusion_;
     current_cycle_ms_ = best_cycle_ms_;
+    current_hier_ = best_hier_;
+    current_cache_ = best_cache_;
     done_ = true;
     if (log_.is_open()) {
       log_ << "converged," << best_fusion_ << "," << best_cycle_ms_ << ","
+           << (best_hier_ ? 1 : 0) << "," << (best_cache_ ? 1 : 0) << ","
            << best_score_ << "\n";
       log_.flush();
     }
@@ -95,24 +113,49 @@ bool ParameterManager::Update(int64_t bytes, double elapsed_sec) {
 }
 
 void ParameterManager::Propose() {
-  // first few samples explore randomly, then EI over the GP posterior
-  if (ys_.size() < 3) {
-    std::vector<double> x = {NextRand(), NextRand()};
-    Decode(x, current_fusion_, current_cycle_ms_);
+  // A candidate point: continuous dims uniform, categorical dims 0/1.
+  // During the initial exploration phase the categoricals walk their
+  // combination grid by sample index (00, 01, 10, 11, ...) so every
+  // enabled category is guaranteed a trial regardless of RNG luck —
+  // the BO-friendly analogue of the reference's grid-chunk walk.
+  auto candidate = [&](size_t grid_idx) {
+    grid_idx %= 4;
+    std::vector<double> x = {NextRand(), NextRand(), 0.0, 0.0};
+    if (opts_.tune_hierarchical) x[2] = (grid_idx >> 1) & 1 ? 1.0 : 0.0;
+    else x[2] = current_hier_ ? 1.0 : 0.0;   // pinned
+    if (opts_.tune_cache) x[3] = grid_idx & 1 ? 1.0 : 0.0;
+    else x[3] = current_cache_ ? 1.0 : 0.0;  // pinned
+    return x;
+  };
+
+  size_t n_random = 3;
+  if (opts_.tune_hierarchical || opts_.tune_cache)
+    n_random = 4;  // initial config + 3 proposals = the full 2x2 grid
+  if (ys_.size() < n_random) {
+    // Propose() runs AFTER sample k was recorded (ys_.size() = k >= 1);
+    // offsetting by the initial config's own grid cell makes proposals
+    // 1..3 cover exactly the three cells the initial config did not
+    std::vector<double> x = candidate(init_grid_ + ys_.size());
+    Decode(x, current_fusion_, current_cycle_ms_, current_hier_,
+           current_cache_);
     return;
   }
   gp_.Fit(xs_, ys_);
   double best_ei = -1;
   std::vector<double> best_x = xs_.back();
   for (int c = 0; c < 64; ++c) {
-    std::vector<double> x = {NextRand(), NextRand()};
+    // EI phase: categorical coords drawn uniformly (candidate() with a
+    // random grid index), continuous coords uniform
+    std::vector<double> x =
+        candidate(static_cast<size_t>(NextRand() * 4.0));
     double ei = gp_.ExpectedImprovement(x);
     if (ei > best_ei) {
       best_ei = ei;
       best_x = x;
     }
   }
-  Decode(best_x, current_fusion_, current_cycle_ms_);
+  Decode(best_x, current_fusion_, current_cycle_ms_, current_hier_,
+         current_cache_);
 }
 
 }  // namespace hvd

@@ -240,7 +240,7 @@ static void TestParameterManagerConverges() {
   po.cycles_per_sample = 2;
   po.max_samples = 16;
   po.gp_noise = 1e-3;
-  pm.Initialize(po, 64 << 20, 1.0);
+  pm.Initialize(po, 64 << 20, 1.0, false, true);
   auto score = [](int64_t fusion, double cycle_ms) {
     double lf = std::log2(static_cast<double>(fusion));
     return 1e9 * std::exp(-0.1 * (lf - 22) * (lf - 22)) *
@@ -262,12 +262,49 @@ static void TestParameterManagerConverges() {
   // current (adopted) params equal the best after convergence
   CHECK(pm.fusion_threshold() == pm.best_fusion_threshold());
   CHECK(pm.cycle_time_ms() == pm.best_cycle_time_ms());
+  // categoricals were pinned (not tuned): never flipped off their init
+  CHECK(pm.hierarchical() == false);
+  CHECK(pm.cache_enabled() == true);
+}
+
+static void TestParameterManagerCategorical() {
+  // Objective rewards hierarchical=on, cache=off 4x over any continuous
+  // setting: the tuner must explore both values of each categorical dim
+  // and converge on the winning combination (reference
+  // parameter_manager.h:186-220 categorical grid).
+  ParameterManager pm;
+  ParameterManager::Options po;
+  po.enabled = true;
+  po.warmup_samples = 1;
+  po.cycles_per_sample = 1;
+  po.max_samples = 20;
+  po.gp_noise = 1e-3;
+  po.tune_hierarchical = true;
+  po.tune_cache = true;
+  pm.Initialize(po, 64 << 20, 1.0, false, true);
+  bool saw_hier[2] = {false, false};
+  bool saw_cache[2] = {false, false};
+  int guard = 0;
+  while (pm.active() && ++guard < 10000) {
+    saw_hier[pm.hierarchical() ? 1 : 0] = true;
+    saw_cache[pm.cache_enabled() ? 1 : 0] = true;
+    double s = 1e8;
+    if (pm.hierarchical()) s *= 2.0;
+    if (!pm.cache_enabled()) s *= 2.0;
+    pm.Update(static_cast<int64_t>(s), 1.0);
+  }
+  CHECK(pm.done());
+  CHECK(saw_hier[0] && saw_hier[1]);
+  CHECK(saw_cache[0] && saw_cache[1]);
+  CHECK(pm.hierarchical() == true);
+  CHECK(pm.cache_enabled() == false);
 }
 
 int main() {
   TestMessageRoundtrip();
   TestGaussianProcessEI();
   TestParameterManagerConverges();
+  TestParameterManagerCategorical();
   TestNegotiatorReadiness();
   TestNegotiatorValidation();
   TestJoinReadiness();

@@ -73,12 +73,15 @@ def _load():
         ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double]
+    lib.hvdc_enqueue_borrow.argtypes = lib.hvdc_enqueue.argtypes
+    lib.hvdc_copy_bytes.restype = ctypes.c_int64
     lib.hvdc_error_message.restype = ctypes.c_char_p
     lib.hvdc_last_error.restype = ctypes.c_char_p
     lib.hvdc_output_size.restype = ctypes.c_int64
     lib.hvdc_copy_output.argtypes = [ctypes.c_int, ctypes.c_void_p]
     lib.hvdc_autotune_state.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     lib.hvdc_control_bytes.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
@@ -126,12 +129,17 @@ def size():
 
 
 class Handle:
-    """Async op handle (reference: horovod/torch/handle_manager.h)."""
+    """Async op handle (reference: horovod/torch/handle_manager.h).
 
-    def __init__(self, h, out_dtype, out_shape_hint=None):
+    When ``borrowed`` is set the core operated zero-copy on that array's
+    buffer: the handle keeps it alive until completion and ``wait``
+    returns it directly (the result is already in place)."""
+
+    def __init__(self, h, out_dtype, out_shape_hint=None, borrowed=None):
         self._h = h
         self._dtype = out_dtype
         self._shape_hint = out_shape_hint
+        self._borrowed = borrowed  # ref holds caller buffer alive
         self._released = False
 
     def poll(self):
@@ -150,6 +158,11 @@ class Handle:
             self._released = True
             raise RuntimeError(msg)
         nbytes = _lib.hvdc_output_size(self._h)
+        if self._borrowed is not None and nbytes == 0:
+            # in-place op on the borrowed buffer: nothing to copy out
+            _lib.hvdc_release(self._h)
+            self._released = True
+            return self._borrowed
         out = np.empty(nbytes, dtype=np.uint8)
         _lib.hvdc_copy_output(self._h,
                               out.ctypes.data_as(ctypes.c_void_p))
@@ -162,26 +175,34 @@ class Handle:
 
 
 def _enqueue(req_type, name, array, op=OP_SUM, root_rank=-1, prescale=1.0,
-             postscale=1.0, out_shape=None):
+             postscale=1.0, out_shape=None, inplace=False):
     lib = _load()
     arr = np.ascontiguousarray(array)
     if arr.dtype not in _DTYPE_MAP:
         raise ValueError(f"unsupported dtype {arr.dtype}")
+    # zero-copy borrow: the core reads (and for allreduce/broadcast
+    # writes) arr's buffer directly; the Handle keeps arr alive. Only
+    # safe when arr is writable — ascontiguousarray preserves read-only
+    # views, so fall back to the copying path for those.
+    borrow = inplace and arr.flags.writeable
     shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
-    h = lib.hvdc_enqueue(req_type, name.encode(),
-                         arr.ctypes.data_as(ctypes.c_void_p), shape,
-                         arr.ndim, _DTYPE_MAP[arr.dtype], op, root_rank,
-                         prescale, postscale)
+    fn = lib.hvdc_enqueue_borrow if borrow else lib.hvdc_enqueue
+    h = fn(req_type, name.encode(),
+           arr.ctypes.data_as(ctypes.c_void_p), shape,
+           arr.ndim, _DTYPE_MAP[arr.dtype], op, root_rank,
+           prescale, postscale)
     if h < 0:
         raise RuntimeError(lib.hvdc_last_error().decode())
-    return Handle(h, arr.dtype, out_shape)
+    return Handle(h, arr.dtype, out_shape, borrowed=arr if borrow else None)
 
 
-def allreduce_async(array, name, op="average", prescale=1.0, postscale=1.0):
+def allreduce_async(array, name, op="average", prescale=1.0, postscale=1.0,
+                    inplace=False):
     arr = np.ascontiguousarray(array)
     req = ADASUM if op == "adasum" else ALLREDUCE
     return _enqueue(req, name, arr, _OP_MAP[op], out_shape=arr.shape,
-                    prescale=prescale, postscale=postscale)
+                    prescale=prescale, postscale=postscale,
+                    inplace=inplace)
 
 
 def allreduce(array, name, op="average", **kw):
@@ -198,14 +219,21 @@ def allgather(array, name):
     return allgather_async(array, name).wait()
 
 
-def broadcast_async(array, name, root_rank=0):
+def broadcast_async(array, name, root_rank=0, inplace=False):
     arr = np.ascontiguousarray(array)
     return _enqueue(BROADCAST, name, arr, root_rank=root_rank,
-                    out_shape=arr.shape)
+                    out_shape=arr.shape, inplace=inplace)
 
 
-def broadcast(array, name, root_rank=0):
-    return broadcast_async(array, name, root_rank).wait()
+def broadcast(array, name, root_rank=0, **kw):
+    return broadcast_async(array, name, root_rank, **kw).wait()
+
+
+def copy_bytes():
+    """Cumulative host-side memcpy bytes the core has performed (enqueue
+    copy-in, fusion staging, output copy-out). The zero-copy ``inplace``
+    paths keep this flat for large tensors."""
+    return int(_load().hvdc_copy_bytes())
 
 
 def reducescatter_async(array, name, op="sum", prescale=1.0, postscale=1.0):
@@ -283,17 +311,22 @@ def data_bytes():
 
 def autotune_state():
     """Autotuner snapshot: dict with ``enabled``, current
-    ``fusion_threshold`` / ``cycle_time_ms``, coordinator-side ``samples``
+    ``fusion_threshold`` / ``cycle_time_ms`` and the categorical
+    ``hierarchical`` / ``cache`` gates, coordinator-side ``samples``
     (-1 on workers) and ``done`` (reference: parameter_manager state)."""
     lib = _load()
     fusion = ctypes.c_int64(0)
     cycle = ctypes.c_double(0.0)
     samples = ctypes.c_int(0)
     done = ctypes.c_int(0)
+    hier = ctypes.c_int(0)
+    cache = ctypes.c_int(0)
     rv = lib.hvdc_autotune_state(ctypes.byref(fusion), ctypes.byref(cycle),
-                                 ctypes.byref(samples), ctypes.byref(done))
+                                 ctypes.byref(samples), ctypes.byref(done),
+                                 ctypes.byref(hier), ctypes.byref(cache))
     if rv < 0:
         raise RuntimeError("native core is not initialized")
     return {"enabled": bool(rv), "fusion_threshold": fusion.value,
             "cycle_time_ms": cycle.value, "samples": samples.value,
-            "done": bool(done.value)}
+            "done": bool(done.value), "hierarchical": bool(hier.value),
+            "cache": bool(cache.value)}

@@ -43,18 +43,26 @@ def _auto_name(kind, name):
 
 
 class TorchHandle:
-    """Wraps a core handle; optionally writes the result back in place."""
+    """Wraps a core handle; optionally writes the result back in place.
 
-    def __init__(self, core_handle, out_tensor=None, postprocess=None):
+    ``inplace_tensor`` marks the zero-copy path: the core borrowed the
+    tensor's own memory, so after ``wait`` the result already sits in it
+    and no copy-back is needed."""
+
+    def __init__(self, core_handle, out_tensor=None, postprocess=None,
+                 inplace_tensor=None):
         self._h = core_handle
         self._out = out_tensor
         self._post = postprocess
+        self._inplace = inplace_tensor
 
     def poll(self):
         return self._h.poll()
 
     def synchronize(self):
         arr = self._h.wait()
+        if self._inplace is not None:
+            return self._inplace
         t = torch.from_numpy(np.array(arr))
         if self._post is not None:
             t = self._post(t)
@@ -96,8 +104,17 @@ def allreduce(tensor, average=True, name=None, op=None, compression=None,
 
 
 def allreduce_async_(tensor, average=True, name=None, op=None, **kw):
-    """In-place: the result is written back into ``tensor``."""
+    """In-place: the result is written back into ``tensor``. Contiguous
+    CPU tensors take the zero-copy path — the core reduces directly in
+    the tensor's memory (reference wraps framework tensors the same way,
+    common.h:188-223)."""
     op = op or (Average if average else Sum)
+    if tensor.device.type == "cpu" and tensor.is_contiguous():
+        _ensure_core()
+        h = _core.allreduce_async(tensor.detach().numpy(),  # shares memory
+                                  _auto_name("allreduce", name), op=op,
+                                  inplace=True, **kw)
+        return TorchHandle(h, inplace_tensor=tensor)
     h = _core.allreduce_async(_to_numpy(tensor),
                               _auto_name("allreduce", name), op=op, **kw)
     return TorchHandle(h, out_tensor=tensor)
@@ -130,6 +147,14 @@ def broadcast(tensor, root_rank, name=None):
 
 
 def broadcast_async_(tensor, root_rank, name=None):
+    """In-place broadcast; contiguous CPU tensors go zero-copy, which is
+    what makes ``broadcast_parameters`` on a large model copy nothing."""
+    if tensor.device.type == "cpu" and tensor.is_contiguous():
+        _ensure_core()
+        h = _core.broadcast_async(tensor.detach().numpy(),  # shares memory
+                                  _auto_name("broadcast", name),
+                                  root_rank=root_rank, inplace=True)
+        return TorchHandle(h, inplace_tensor=tensor)
     h = _core.broadcast_async(_to_numpy(tensor),
                               _auto_name("broadcast", name),
                               root_rank=root_rank)

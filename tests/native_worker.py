@@ -285,6 +285,38 @@ def scenario_cache_invalidation(rank, size):
     np.testing.assert_allclose(out, np.full((2, 3), size * (size - 1) / 2.0))
 
 
+def scenario_zerocopy(rank, size):
+    """Borrowed-buffer enqueue: broadcast and single-tensor allreduce
+    operate directly in the caller's numpy buffer — the core's memcpy
+    counter must not move (the reference wraps framework tensors
+    zero-copy, common.h:188-223; this is that guarantee, asserted)."""
+    n = 1 << 20  # 4 MB fp32
+    x = np.full(n, float(rank), dtype=np.float32)
+    core.barrier()
+    c0 = core.copy_bytes()
+    h = core.broadcast_async(x, "zc.bc", root_rank=0, inplace=True)
+    out = h.wait()
+    assert out is x
+    np.testing.assert_array_equal(x, np.zeros(n, dtype=np.float32))
+    c1 = core.copy_bytes()
+    assert c1 - c0 == 0, ("broadcast copied", c1 - c0)
+
+    y = np.full(n, rank + 1.0, dtype=np.float32)
+    h = core.allreduce_async(y, "zc.ar", op="sum", inplace=True)
+    out = h.wait()
+    assert out is y
+    np.testing.assert_allclose(y, np.full(n, size * (size + 1) / 2.0))
+    c2 = core.copy_bytes()
+    assert c2 - c1 == 0, ("allreduce copied", c2 - c1)
+
+    # counter sanity: the copying path counts copy-in + copy-out
+    z = np.full(n, rank + 1.0, dtype=np.float32)
+    core.allreduce(z, "zc.copy", op="sum")
+    c3 = core.copy_bytes()
+    assert c3 - c2 >= 2 * n * 4, ("copy path under-counted", c3 - c2)
+    core.barrier()
+
+
 def scenario_hierarchy(rank, size):
     """Fixed collective workload under a faked multi-host topology
     (HOROVOD_LOCAL_SIZE set by the test); values must be exact whether the
@@ -333,12 +365,34 @@ def scenario_autotune(rank, size):
     core.barrier()
     st = core.autotune_state()
     print("TUNED", json.dumps([st["fusion_threshold"],
-                               round(st["cycle_time_ms"], 6)]))
+                               round(st["cycle_time_ms"], 6),
+                               st["hierarchical"], st["cache"]]))
+
+
+def scenario_hierarchy_mismatch(rank, size):
+    """Only rank 0 exported a multi-host topology (env drift): the
+    coordinator-agreed gate must turn hierarchy off for EVERYONE — a
+    per-rank decision would run mismatched ring schedules and hang."""
+    x = np.arange(256, dtype=np.float32) + rank
+    out = core.allreduce(x, "hm.ar", op="average")
+    np.testing.assert_allclose(
+        out, np.arange(256, dtype=np.float32) + (size - 1) / 2.0, rtol=1e-6)
+    out = core.allgather(np.full((rank + 1, 2), rank, dtype=np.float32),
+                         "hm.ag")
+    expected = np.concatenate(
+        [np.full((r + 1, 2), r, dtype=np.float32) for r in range(size)])
+    np.testing.assert_array_equal(out, expected)
+    core.barrier()
 
 
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))
+    if scenario == "hierarchy_mismatch" and rank == 0:
+        # env drift happens BEFORE core init (getenv is read there):
+        # rank 0 claims a flat topology while everyone else (test env)
+        # claims 2-level and requests hierarchical collectives
+        os.environ["HOROVOD_LOCAL_SIZE"] = str(size)
     core.init(rank=rank, size=size, coord_host="127.0.0.1",
               coord_port=port)
     try:

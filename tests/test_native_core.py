@@ -134,6 +134,55 @@ def test_hierarchical_cuts_cross_host_traffic():
         f"ring's total {flat_total}")
 
 
+def test_autotune_categorical_dims_explored_and_synced(tmp_path):
+    """With a faked 2x2 topology the BO loop searches the categorical
+    hierarchical/cache dims alongside (fusion, cycle): the log must show
+    both values of each categorical tried, and all ranks must agree on
+    the winning combination (reference parameter_manager.h:186-220)."""
+    log = tmp_path / "autotune.csv"
+    results = _run_workers("autotune", 4, env_extra={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": str(log),
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "2",
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "8",
+        "HOROVOD_LOCAL_SIZE": "2",
+    }, timeout=180)
+    import json as _json
+    tuned = []
+    for out, _ in results:
+        line = [l for l in out.splitlines() if l.startswith("TUNED ")][0]
+        tuned.append(tuple(_json.loads(line[len("TUNED "):])))
+    assert len(set(tuned)) == 1, f"ranks disagree on tuned params: {tuned}"
+    rows = [l.split(",") for l in log.read_text().strip().splitlines()
+            if not l.startswith(("sample", "converged"))]
+    hier_vals = {r[3] for r in rows}
+    cache_vals = {r[4] for r in rows}
+    assert hier_vals == {"0", "1"}, f"hierarchical dim not explored: {rows}"
+    assert cache_vals == {"0", "1"}, f"cache dim not explored: {rows}"
+
+
+def test_hierarchical_gate_agreed_not_split_on_env_drift():
+    """Every rank requests hierarchical collectives but rank 0's topology
+    env drifted (claims flat): the coordinator must turn the gates off
+    for the whole job — a per-rank decision would deadlock mismatched
+    ring schedules. The workload completing with exact values IS the
+    assertion (a split decision hangs into the timeout)."""
+    _run_workers("hierarchy_mismatch", 8, env_extra={
+        "HOROVOD_LOCAL_SIZE": "4",
+        "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+        "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+    }, timeout=120)
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_zero_copy_enqueue(size):
+    """Borrowed buffers move zero host-side memcpy bytes for broadcast
+    and single-tensor allreduce (asserted in the worker via the core's
+    copy counter)."""
+    _run_workers("zerocopy", size)
+
+
 def test_join_uneven_ranks():
     _run_workers("join", 4)
 
