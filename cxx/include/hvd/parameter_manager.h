@@ -29,6 +29,10 @@ class ParameterManager {
     bool enabled = false;
     int warmup_samples = 3;
     int cycles_per_sample = 50;
+    // windows measured (and averaged) per proposal before the score is
+    // recorded — bursty enqueue patterns alias into a single window, so
+    // one window per config is a noisy objective for the GP
+    int sample_repeats = 2;
     int max_samples = 20;
     double gp_noise = 1e-3;
     std::string log_file;
@@ -69,6 +73,7 @@ class ParameterManager {
   int64_t bytes_acc_ = 0;
   double time_acc_ = 0;
   int warmup_left_ = 0;
+  std::vector<double> window_scores_;  // repeats for the current proposal
 
   // normalized coords: x0 = log2(fusion)/26, x1 = cycle/25,
   // x2 = hierarchical (0/1), x3 = cache (0/1)

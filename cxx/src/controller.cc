@@ -94,7 +94,9 @@ Status ControlPlane::Initialize(const std::string& advertise_host,
       if (i == 0) { L = c.local_size; C = c.cross_size; }
       if (c.local_size != L || c.cross_size != C || L < 2 || C < 2 ||
           L * C != size_ ||
-          i != c.cross_rank * c.local_size + c.local_rank)
+          c.local_rank < 0 || c.local_rank >= L ||   // out-of-range claims
+          c.cross_rank < 0 || c.cross_rank >= C ||   // can still satisfy
+          i != c.cross_rank * c.local_size + c.local_rank)  // the identity
         capable = false;
     }
     uint8_t agreed = 0;

@@ -268,12 +268,16 @@ void ExecuteFusedAllreduce(const Response& resp) {
   for (size_t i = 0; i < entries.size(); ++i) {
     int64_t nbytes = resp.tensor_sizes[i] * esz;
     if (have[i]) {
-      uint8_t* dst = EntryPtr(entries[i]);
-      std::memcpy(dst, fused.data() + off, nbytes);
-      g->copied_bytes.fetch_add(nbytes);
-      if (st.ok() && entries[i].postscale != 1.0)
-        ScaleInPlace(dst, resp.tensor_sizes[i], resp.dtype,
-                     entries[i].postscale);
+      // on failure the fusion buffer holds partially-reduced garbage —
+      // leave the entry (especially a borrowed caller tensor) untouched
+      if (st.ok()) {
+        uint8_t* dst = EntryPtr(entries[i]);
+        std::memcpy(dst, fused.data() + off, nbytes);
+        g->copied_bytes.fetch_add(nbytes);
+        if (entries[i].postscale != 1.0)
+          ScaleInPlace(dst, resp.tensor_sizes[i], resp.dtype,
+                       entries[i].postscale);
+      }
       CompleteEntry(entries[i], st);
     }
     off += nbytes;
@@ -923,6 +927,8 @@ int hvdc_init(int rank, int size, const char* coord_host, int coord_port,
         static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3));
     po.cycles_per_sample =
         static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10));
+    po.sample_repeats =
+        static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_SAMPLE_REPEATS", 2));
     po.max_samples = static_cast<int>(
         EnvInt("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20));
     po.gp_noise =

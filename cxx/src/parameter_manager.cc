@@ -78,6 +78,17 @@ bool ParameterManager::Update(int64_t bytes, double elapsed_sec) {
     return false;
   }
 
+  // average repeated windows at the SAME proposal before recording: one
+  // window of whatever happened to be in flight is too noisy a sample
+  // for the GP (the reference averages repeated samples the same way)
+  window_scores_.push_back(score);
+  if (static_cast<int>(window_scores_.size()) < opts_.sample_repeats)
+    return false;
+  score = 0;
+  for (double w : window_scores_) score += w;
+  score /= static_cast<double>(window_scores_.size());
+  window_scores_.clear();
+
   xs_.push_back(Encode(current_fusion_, current_cycle_ms_, current_hier_,
                        current_cache_));
   ys_.push_back(score);

@@ -267,6 +267,26 @@ static void TestParameterManagerConverges() {
   CHECK(pm.cache_enabled() == true);
 }
 
+static void TestParameterManagerSampleAveraging() {
+  // sample_repeats windows at the same proposal average into ONE
+  // recorded sample — a lone bursty window must not become the score
+  ParameterManager pm;
+  ParameterManager::Options po;
+  po.enabled = true;
+  po.warmup_samples = 0;
+  po.cycles_per_sample = 1;
+  po.sample_repeats = 3;
+  po.max_samples = 1;
+  pm.Initialize(po, 64 << 20, 1.0, false, true);
+  pm.Update(100, 1.0);
+  CHECK(pm.samples() == 0);
+  pm.Update(200, 1.0);
+  CHECK(pm.samples() == 0);
+  pm.Update(600, 1.0);
+  CHECK(pm.samples() == 1);
+  CHECK(std::abs(pm.best_score() - 300.0) < 1e-9);  // mean(100,200,600)
+}
+
 static void TestParameterManagerCategorical() {
   // Objective rewards hierarchical=on, cache=off 4x over any continuous
   // setting: the tuner must explore both values of each categorical dim
@@ -304,6 +324,7 @@ int main() {
   TestMessageRoundtrip();
   TestGaussianProcessEI();
   TestParameterManagerConverges();
+  TestParameterManagerSampleAveraging();
   TestParameterManagerCategorical();
   TestNegotiatorReadiness();
   TestNegotiatorValidation();

@@ -128,6 +128,13 @@ def size():
     return _lib.hvdc_size() if _lib is not None else -1
 
 
+# Buffers the core is borrowing, keyed by handle: the registry (not just
+# the Handle object) pins each array until wait()/release, so a caller
+# that fires-and-forgets an inplace op can never leave the background
+# loop holding a pointer into freed numpy memory.
+_borrowed_refs = {}
+
+
 class Handle:
     """Async op handle (reference: horovod/torch/handle_manager.h).
 
@@ -140,6 +147,8 @@ class Handle:
         self._dtype = out_dtype
         self._shape_hint = out_shape_hint
         self._borrowed = borrowed  # ref holds caller buffer alive
+        if borrowed is not None:
+            _borrowed_refs[h] = borrowed
         self._released = False
 
     def poll(self):
@@ -152,6 +161,7 @@ class Handle:
         if self._released:
             raise RuntimeError("handle already synchronized")
         rv = _lib.hvdc_wait(self._h)
+        _borrowed_refs.pop(self._h, None)  # op done: core dropped the ptr
         if rv != 1:
             msg = _lib.hvdc_error_message(self._h).decode()
             _lib.hvdc_release(self._h)
@@ -181,10 +191,16 @@ def _enqueue(req_type, name, array, op=OP_SUM, root_rank=-1, prescale=1.0,
     if arr.dtype not in _DTYPE_MAP:
         raise ValueError(f"unsupported dtype {arr.dtype}")
     # zero-copy borrow: the core reads (and for allreduce/broadcast
-    # writes) arr's buffer directly; the Handle keeps arr alive. Only
-    # safe when arr is writable — ascontiguousarray preserves read-only
-    # views, so fall back to the copying path for those.
-    borrow = inplace and arr.flags.writeable
+    # writes) the caller's buffer directly. The in-place promise only
+    # holds for a C-contiguous writable array — anything else would
+    # silently reduce into a hidden ascontiguousarray copy while the
+    # caller keeps reading their stale original, so refuse loudly.
+    if inplace and (arr is not array or not arr.flags.writeable):
+        raise ValueError(
+            "inplace=True requires a C-contiguous writable ndarray "
+            "(got a copy or read-only view); drop inplace or pass "
+            "np.ascontiguousarray(x) yourself and read the result there")
+    borrow = inplace
     shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
     fn = lib.hvdc_enqueue_borrow if borrow else lib.hvdc_enqueue
     h = fn(req_type, name.encode(),
@@ -198,11 +214,12 @@ def _enqueue(req_type, name, array, op=OP_SUM, root_rank=-1, prescale=1.0,
 
 def allreduce_async(array, name, op="average", prescale=1.0, postscale=1.0,
                     inplace=False):
-    arr = np.ascontiguousarray(array)
     req = ADASUM if op == "adasum" else ALLREDUCE
-    return _enqueue(req, name, arr, _OP_MAP[op], out_shape=arr.shape,
-                    prescale=prescale, postscale=postscale,
-                    inplace=inplace)
+    # the caller's array goes straight to _enqueue: its single
+    # ascontiguousarray is what the inplace contract checks against
+    return _enqueue(req, name, array, _OP_MAP[op],
+                    out_shape=np.shape(array), prescale=prescale,
+                    postscale=postscale, inplace=inplace)
 
 
 def allreduce(array, name, op="average", **kw):
@@ -220,9 +237,8 @@ def allgather(array, name):
 
 
 def broadcast_async(array, name, root_rank=0, inplace=False):
-    arr = np.ascontiguousarray(array)
-    return _enqueue(BROADCAST, name, arr, root_rank=root_rank,
-                    out_shape=arr.shape, inplace=inplace)
+    return _enqueue(BROADCAST, name, array, root_rank=root_rank,
+                    out_shape=np.shape(array), inplace=inplace)
 
 
 def broadcast(array, name, root_rank=0, **kw):

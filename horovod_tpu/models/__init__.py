@@ -30,8 +30,10 @@ from horovod_tpu.models.resnet import (
 from horovod_tpu.models.simple import MNISTConvNet, MLP
 from horovod_tpu.models.vgg import VGG16
 from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from horovod_tpu.models.moe import MoE
 
 __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
     "MNISTConvNet", "MLP", "VGG16", "Transformer", "TransformerConfig",
+    "MoE",
 ]

@@ -17,8 +17,14 @@ from horovod_tpu.parallel.mesh import (
     DCN_AXIS,
 )
 from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+from horovod_tpu.parallel.tensor import (
+    make_tp_lm_train_step,
+    shard_lm_state,
+    transformer_param_specs,
+)
 
 __all__ = [
     "build_mesh", "get_mesh", "set_mesh", "data_axis_names",
     "DATA_AXIS", "DCN_AXIS", "hierarchical_allreduce",
+    "make_tp_lm_train_step", "shard_lm_state", "transformer_param_specs",
 ]

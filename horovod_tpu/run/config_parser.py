@@ -21,6 +21,7 @@ ARG_TO_ENV = {
     "autotune_log_file": "HOROVOD_AUTOTUNE_LOG",
     "autotune_warmup_samples": "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
     "autotune_steps_per_sample": "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+    "autotune_sample_repeats": "HOROVOD_AUTOTUNE_SAMPLE_REPEATS",
     "autotune_bayes_opt_max_samples":
         "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
     "autotune_gaussian_process_noise":

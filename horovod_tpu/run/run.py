@@ -53,6 +53,7 @@ def build_parser():
     tune.add_argument("--autotune-log-file", default=None)
     tune.add_argument("--autotune-warmup-samples", type=int, default=None)
     tune.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    tune.add_argument("--autotune-sample-repeats", type=int, default=None)
     tune.add_argument("--autotune-bayes-opt-max-samples", type=int,
                       default=None)
     tune.add_argument("--autotune-gaussian-process-noise", type=float,

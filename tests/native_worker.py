@@ -314,7 +314,21 @@ def scenario_zerocopy(rank, size):
     core.allreduce(z, "zc.copy", op="sum")
     c3 = core.copy_bytes()
     assert c3 - c2 >= 2 * n * 4, ("copy path under-counted", c3 - c2)
-    core.barrier()
+
+    # the inplace promise is explicit: a non-contiguous array would
+    # silently reduce into a hidden copy, so it must refuse instead
+    nc = np.ones((8, 8), dtype=np.float32)[:, ::2]
+    try:
+        core.allreduce_async(nc, "zc.bad", op="sum", inplace=True)
+        raise SystemExit("expected inplace ValueError")
+    except ValueError as e:
+        assert "contiguous" in str(e), str(e)
+
+    # fire-and-forget: the handle is dropped before completion; the
+    # borrow registry must keep the buffer alive for the background loop
+    core.broadcast_async(np.full(n, float(rank), dtype=np.float32),
+                         "zc.ff", root_rank=0, inplace=True)
+    core.barrier()  # completes the dropped-handle op safely
 
 
 def scenario_hierarchy(rank, size):

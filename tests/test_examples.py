@@ -63,6 +63,14 @@ def test_lm_seq_parallel_example():
     assert "data x seq" in out
 
 
+def test_lm_tensor_parallel_example():
+    out = _run([sys.executable, "examples/jax_lm_tensor_parallel.py",
+                "--steps", "6", "--d-model", "32", "--seq-len", "32"],
+               virtual_mesh=True)
+    assert "d_ff kernel sharding: PartitionSpec(None, 'model')" in out
+    assert "done" in out
+
+
 def test_scaling_harness_tiny():
     out = _run([sys.executable, "bench_scaling.py", "--model", "resnet18",
                 "--batch-size", "2", "--image-size", "32",

@@ -1,0 +1,88 @@
+"""Expert-parallel MoE: the sharded program must equal the single-device
+oracle (dispatch math is global, so 1-device IS the oracle), experts must
+actually live sharded, and capacity overflow must drop cleanly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.models.moe import MoE, shard_moe_params
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:8]), ("expert",))
+
+
+@pytest.fixture()
+def x(rng):
+    return jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+
+
+def test_expert_parallel_matches_single_device(x):
+    mesh = _mesh()
+    kwargs = dict(num_experts=8, d_model=16, d_ff=32)
+    oracle = MoE(**kwargs)
+    params = oracle.init(jax.random.PRNGKey(0), x)["params"]
+    want = oracle.apply({"params": params}, x)
+
+    ep = MoE(**kwargs, mesh=mesh)
+    sharded = shard_moe_params(params, mesh)
+    xs = jax.device_put(x, NamedSharding(mesh, P("expert", None)))
+    got = jax.jit(lambda p, v: ep.apply({"params": p}, v))(sharded, xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-6)
+
+
+def test_expert_weights_actually_sharded(x):
+    mesh = _mesh()
+    moe = MoE(num_experts=8, d_model=16, d_ff=32, mesh=mesh)
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    sharded = shard_moe_params(params, mesh)
+    assert sharded["w_in"].sharding.spec == P("expert", None, None)
+    # each device holds exactly one expert's weights
+    assert sharded["w_in"].addressable_shards[0].data.shape == (1, 16, 32)
+    assert sharded["gate"].sharding.spec == P()
+
+
+def test_capacity_overflow_drops_not_crashes(rng):
+    # all tokens prefer one expert: capacity C = ceil(T/E * cf) drops the
+    # overflow; output rows past capacity are exactly zero (residual
+    # connections carry them in a full model)
+    moe = MoE(num_experts=4, d_model=8, d_ff=16, capacity_factor=1.0)
+    x = jnp.ones((16, 8), jnp.float32)  # identical tokens, same argmax
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    out = moe.apply({"params": params}, x)
+    assert np.isfinite(np.asarray(out)).all()
+    nonzero_rows = np.abs(np.asarray(out)).sum(axis=-1) > 0
+    assert nonzero_rows.sum() == 4  # C = 16/4 * 1.0 = 4 kept
+
+
+def test_moe_trains(x):
+    mesh = _mesh()
+    moe = MoE(num_experts=8, d_model=16, d_ff=32, mesh=mesh)
+    params = shard_moe_params(
+        moe.init(jax.random.PRNGKey(0), x)["params"], mesh)
+    tx = optax.adam(1e-2)
+    opt = jax.jit(tx.init)(params)
+    target = jnp.asarray(
+        np.random.default_rng(1).standard_normal((64, 16)), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("expert", None)))
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            out = moe.apply({"params": p}, xs)
+            return jnp.mean((out - target) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        upd, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    losses = []
+    for _ in range(15):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
