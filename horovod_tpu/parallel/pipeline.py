@@ -1,0 +1,98 @@
+"""Pipeline parallelism: a GPipe microbatch schedule over a ``stage``
+mesh axis, built from ``shard_map`` + ``lax.scan`` + ``ppermute``.
+
+Beyond-parity (SURVEY §2.7 marks PP absent from the 2019 reference) —
+the TPU-native formulation: the layer stack's parameters are STACKED on
+a leading dim and sharded over the ``stage`` axis (each stage holds its
+contiguous slice of layers), activations flow stage-to-stage with
+``ppermute`` inside a compiled ``scan`` over schedule ticks, and the
+whole pipeline stays one differentiable XLA program — reverse-mode AD
+routes gradients backward through the transposed ``ppermute``s, so
+backward pipelining comes from the autodiff transpose instead of
+hand-written schedule code.
+
+Schedule: ``T = n_micro + n_stages - 1`` ticks. At tick ``t`` stage
+``s`` processes microbatch ``t - s``. Bubble ticks compute on a REAL
+microbatch (the state is seeded with ``micro[0]``, never zeros) whose
+outputs are ``where``-masked away: the mask makes the bubble chains'
+parameter cotangents exactly zero, but only because the bubble
+intermediates are finite — a zero seed would send blocks with
+norm/division structure (x/||x||, RMSNorm) through a point where the
+vjp is NaN, and ``NaN * 0`` would poison the shared parameter
+gradients. The last stage's collected outputs are ``psum``-replicated
+back to every stage so the caller's loss sees a replicated activation.
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def stack_params(param_trees):
+    """Stack per-layer param trees along a new leading dim — the layout
+    ``pipelined_forward`` shards over the stage axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *param_trees)
+
+
+def pipelined_forward(block_fn: Callable[[Any, Any], Any], stacked_params,
+                      h, *, mesh, axis_name="stage", n_micro=None):
+    """Run ``h`` through the stacked layers as a GPipe pipeline.
+
+    ``block_fn(layer_params, x) -> x`` applies ONE layer. ``stacked_params``
+    has every leaf stacked ``[L, ...]``; L must divide by the stage-axis
+    size (each stage scans its local layers in order). ``h`` is the
+    replicated input activation ``[B, ...]`` with ``B`` divisible by
+    ``n_micro`` (default: one microbatch per stage).
+    """
+    n_stages = mesh.shape[axis_name]
+    if n_micro is None:
+        n_micro = n_stages
+    B = h.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
+    L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+
+    def inner(params, h):
+        n = jax.lax.axis_size(axis_name)
+        s = jax.lax.axis_index(axis_name)
+        micro = h.reshape(n_micro, B // n_micro, *h.shape[1:])
+
+        def apply_local(x):
+            # this stage's slice of the layer stack, in order
+            return jax.lax.scan(
+                lambda c, p: (block_fn(p, c), None), x, params)[0]
+
+        def tick(carry, t):
+            state, outs = carry
+            x_in = jax.lax.dynamic_index_in_dim(
+                micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+            cur = jnp.where(s == 0, x_in, state)
+            y = apply_local(cur)
+            idx = t - (n - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outs, y, jnp.clip(idx, 0, n_micro - 1), 0)
+            take = (s == n - 1) & (idx >= 0) & (idx < n_micro)
+            outs = jnp.where(take, upd, outs)
+            # hand my output to the next stage (stage 0 receives zeros)
+            state = jax.lax.ppermute(
+                y, axis_name, [(i, i + 1) for i in range(n - 1)])
+            return (state, outs), None
+
+        # seed bubbles with real data (see module docstring: a zeros seed
+        # NaN-poisons gradients of norm-structured blocks); its masked
+        # output contributes exactly zero cotangent
+        state0 = micro[0]
+        outs0 = jnp.zeros_like(micro)
+        (_, outs), _ = jax.lax.scan(
+            tick, (state0, outs0), jnp.arange(n_micro + n_stages - 1))
+        # replicate the finished microbatches from the last stage
+        outs = jax.lax.psum(
+            jnp.where(s == n - 1, outs, jnp.zeros_like(outs)), axis_name)
+        return outs.reshape(h.shape)
+
+    return jax.shard_map(inner, mesh=mesh, in_specs=(P(axis_name), P()),
+                         out_specs=P(), check_vma=False)(stacked_params, h)
