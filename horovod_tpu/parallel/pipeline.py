@@ -37,21 +37,30 @@ def stack_params(param_trees):
 
 
 def pipelined_forward(block_fn: Callable[[Any, Any], Any], stacked_params,
-                      h, *, mesh, axis_name="stage", n_micro=None):
+                      h, *, mesh, axis_name="stage", n_micro=None,
+                      batch_axis=None):
     """Run ``h`` through the stacked layers as a GPipe pipeline.
 
     ``block_fn(layer_params, x) -> x`` applies ONE layer. ``stacked_params``
     has every leaf stacked ``[L, ...]``; L must divide by the stage-axis
-    size (each stage scans its local layers in order). ``h`` is the
-    replicated input activation ``[B, ...]`` with ``B`` divisible by
+    size (each stage scans its local layers in order). ``h`` is the input
+    activation ``[B, ...]`` with the per-shard batch divisible by
     ``n_micro`` (default: one microbatch per stage).
+
+    ``batch_axis`` composes PP with DP: ``h``'s leading dim shards over
+    that mesh axis and each data slice runs its own pipeline; the stacked
+    params are replicated across ``batch_axis``, so their reverse-mode
+    cotangents are psum'd over it by the ``shard_map`` transpose — the
+    gradient allreduce falls out for free.
     """
     n_stages = mesh.shape[axis_name]
     if n_micro is None:
         n_micro = n_stages
     B = h.shape[0]
-    if B % n_micro:
-        raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
+    dp = mesh.shape[batch_axis] if batch_axis else 1
+    if B % (n_micro * dp):
+        raise ValueError(
+            f"batch {B} not divisible by n_micro={n_micro} x dp={dp}")
     L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if L % n_stages:
         raise ValueError(f"{L} layers not divisible by {n_stages} stages")
@@ -59,7 +68,7 @@ def pipelined_forward(block_fn: Callable[[Any, Any], Any], stacked_params,
     def inner(params, h):
         n = jax.lax.axis_size(axis_name)
         s = jax.lax.axis_index(axis_name)
-        micro = h.reshape(n_micro, B // n_micro, *h.shape[1:])
+        micro = h.reshape(n_micro, h.shape[0] // n_micro, *h.shape[1:])
 
         def apply_local(x):
             # this stage's slice of the layer stack, in order
@@ -94,5 +103,8 @@ def pipelined_forward(block_fn: Callable[[Any, Any], Any], stacked_params,
             jnp.where(s == n - 1, outs, jnp.zeros_like(outs)), axis_name)
         return outs.reshape(h.shape)
 
-    return jax.shard_map(inner, mesh=mesh, in_specs=(P(axis_name), P()),
-                         out_specs=P(), check_vma=False)(stacked_params, h)
+    io_spec = P(batch_axis) if batch_axis else P()
+    return jax.shard_map(inner, mesh=mesh,
+                         in_specs=(P(axis_name), io_spec),
+                         out_specs=io_spec,
+                         check_vma=False)(stacked_params, h)
