@@ -43,6 +43,8 @@ def args_to_env(args):
         if val is True:
             val = "1"
         env[var] = str(val)
+    if getattr(args, "disable_cache", False):  # reference --disable-cache
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
     return env
 
 

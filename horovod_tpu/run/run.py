@@ -16,12 +16,63 @@ from horovod_tpu.run.discovery import DriverService
 from horovod_tpu.run.rendezvous import KVStoreServer
 
 
+def _version():
+    from horovod_tpu import __version__
+    return f"hvdrun (horovod_tpu) {__version__}"
+
+
+def check_build():
+    """Print what this build supports (reference: ``horovodrun
+    --check-build``, run.py:407 — its framework/controller/tensor-op
+    checkboxes, mapped to this framework's planes and adapters)."""
+    import importlib.util
+
+    def have(mod):
+        # full meta-path probe (editable installs register meta_path
+        # finders that PathFinder alone would miss), without importing
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except (ImportError, ValueError):
+            return False
+
+    from horovod_tpu import _core
+    core_ok = _core.core_available()
+    lines = [
+        _version(),
+        "",
+        "Available frameworks:",
+        f"    [{'X' if have('jax') else ' '}] JAX (compiled XLA data plane)",
+        f"    [{'X' if have('torch') else ' '}] PyTorch",
+        f"    [{'X' if have('tensorflow') else ' '}] TensorFlow",
+        f"    [{'X' if have('mxnet') else ' '}] MXNet",
+        "",
+        "Available controllers:",
+        f"    [{'X' if core_ok else ' '}] TCP (native host core)",
+        "",
+        "Available tensor operations:",
+        f"    [{'X' if have('jax') else ' '}] XLA collectives (ICI/DCN)",
+        f"    [{'X' if core_ok else ' '}] host ring collectives "
+        "(allreduce/allgatherv/broadcast/alltoall/reducescatter/Adasum)",
+    ]
+    try:
+        print("\n".join(lines))
+    except BrokenPipeError:  # `hvdrun -cb | head` closing early is fine
+        pass
+
+
 def build_parser():
     p = argparse.ArgumentParser(
         prog="hvdrun",
         description="Launch a horovod_tpu training job "
                     "(one process per slot; no MPI required).")
-    p.add_argument("-np", "--num-proc", type=int, required=True,
+    p.add_argument("-v", "--version", action="version",
+                   version=_version(),
+                   help="show the horovod_tpu version and exit")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print which frameworks/adapters and core "
+                        "features this build supports, then exit "
+                        "(reference: horovodrun --check-build)")
+    p.add_argument("-np", "--num-proc", type=int,
                    help="total number of training processes")
     p.add_argument("-H", "--hosts", default=None,
                    help='host slots, e.g. "h1:4,h2:4" (default: localhost)')
@@ -47,6 +98,9 @@ def build_parser():
     tune.add_argument("--fusion-threshold-mb", type=int, default=None)
     tune.add_argument("--cycle-time-ms", type=float, default=None)
     tune.add_argument("--cache-capacity", type=int, default=None)
+    tune.add_argument("--disable-cache", action="store_true",
+                      help="turn the response cache off "
+                           "(HOROVOD_CACHE_CAPACITY=0)")
     tune.add_argument("--hierarchical-allreduce", action="store_true")
     tune.add_argument("--hierarchical-allgather", action="store_true")
     tune.add_argument("--autotune", action="store_true")
@@ -77,9 +131,14 @@ def build_parser():
 def parse_args(argv=None):
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]  # `hvdrun -np 4 -- python ...`
     if args.config_file:
         defaults = {a.dest: a.default for a in parser._actions}
         config_parser.load_config_file(args.config_file, args, defaults)
+    # after the config overlay: the YAML may supply num-proc
+    if not args.check_build and args.num_proc is None:
+        parser.error("-np/--num-proc is required")
     return args
 
 
@@ -197,6 +256,9 @@ def _run(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.check_build:
+        check_build()
+        return 0
     try:
         _run(args)
     except RuntimeError as e:

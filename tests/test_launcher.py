@@ -70,6 +70,23 @@ def test_args_to_env():
     assert args.command == ["python", "train.py"]
 
 
+def test_command_separator_and_disable_cache():
+    # `hvdrun -np 2 -- python train.py` (the reference accepts both forms)
+    args = parse_args(["-np", "2", "--disable-cache", "--",
+                       "python", "train.py"])
+    assert args.command == ["python", "train.py"]
+    assert config_parser.args_to_env(args)["HOROVOD_CACHE_CAPACITY"] == "0"
+
+
+def test_check_build_prints_planes(capsys):
+    from horovod_tpu.run.run import main
+    assert main(["--check-build"]) == 0
+    out = capsys.readouterr().out
+    assert "Available frameworks" in out
+    assert "[X] JAX" in out
+    assert "TCP (native host core)" in out
+
+
 def test_config_file(tmp_path):
     cfg = tmp_path / "cfg.yaml"
     cfg.write_text(textwrap.dedent("""
