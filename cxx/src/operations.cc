@@ -149,6 +149,7 @@ struct Global {
 
   // join state
   std::vector<bool> joined_ranks;     // coordinator
+  int last_joiner = -1;  // coordinator: rank whose join completed the set
   bool self_joined = false;
   int join_handle = -1;
   std::mutex join_mu;
@@ -429,7 +430,13 @@ void ExecuteResponse(const Response& resp) {
     case Response::JOIN: {
       std::lock_guard<std::mutex> lock(g->join_mu);
       if (g->join_handle >= 0) {
-        g->handles.MarkDone(g->join_handle, Status::OK());
+        // payload: the last-joined rank as int32 (hvd.join's return)
+        int32_t last = resp.tensor_sizes.empty()
+                           ? -1
+                           : static_cast<int32_t>(resp.tensor_sizes[0]);
+        std::vector<uint8_t> out(sizeof(last));
+        std::memcpy(out.data(), &last, sizeof(last));
+        g->handles.MarkDone(g->join_handle, Status::OK(), std::move(out));
         g->join_handle = -1;
       }
       g->self_joined = false;
@@ -458,6 +465,7 @@ ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
       if (q.type == Request::JOIN) {
         if (!g->joined_ranks[r]) {
           g->joined_ranks[r] = true;
+          g->last_joiner = r;
           join_changed = true;
         }
       } else {
@@ -597,6 +605,9 @@ ResponseList CoordinatorNegotiate(std::vector<RequestList>& per_rank) {
       Response r;
       r.type = Response::JOIN;
       r.tensor_names = {"join.noname"};
+      // reference hvd.join() returns the rank that joined LAST — ride
+      // it in tensor_sizes so every rank learns it
+      r.tensor_sizes = {g->last_joiner};
       return r;
     }());
 

@@ -282,17 +282,24 @@ def alltoall(array, name):
 
 
 def join():
-    """Announce data exhaustion; returns when every rank has joined
-    (reference EnqueueJoin, operations.cc:909)."""
+    """Announce data exhaustion; returns the rank that joined LAST once
+    every rank has joined (reference EnqueueJoin + hvd.join()'s
+    last-joined-rank return, operations.cc:909)."""
     lib = _load()
     h = lib.hvdc_enqueue_join()
     if h < 0:
         raise RuntimeError("join: core not initialized")
     rv = lib.hvdc_wait(h)
     msg = lib.hvdc_error_message(h).decode()
+    last = -1
+    if rv == 1 and lib.hvdc_output_size(h) == 4:
+        out = np.zeros(1, dtype=np.int32)
+        lib.hvdc_copy_output(h, out.ctypes.data_as(ctypes.c_void_p))
+        last = int(out[0])
     lib.hvdc_release(h)
     if rv != 1:
         raise RuntimeError(f"join failed: {msg}")
+    return last
 
 
 def barrier():

@@ -27,7 +27,13 @@ which is graph-capable on real TF (``tf.numpy_function`` +
 import tensorflow as tf
 
 from horovod_tpu.ops.reduction import Average
-from horovod_tpu.tensorflow import (Compression, _allreduce_grads, size)
+# allgather/allreduce/broadcast/broadcast_global_variables are re-exported
+# here the way the reference's keras namespace does (keras/__init__.py),
+# so pure-Keras scripts never import horovod.tensorflow directly
+from horovod_tpu.tensorflow import (Compression,  # noqa: F401
+                                    _allreduce_grads, allgather, allreduce,
+                                    broadcast, broadcast_global_variables,
+                                    size)
 
 from horovod_tpu.tensorflow import callbacks  # noqa: F401  (re-export)
 

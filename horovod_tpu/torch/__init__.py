@@ -19,7 +19,8 @@ from horovod_tpu.torch.mpi_ops import (Adasum, Average, Max, Min, Sum,
                                        allreduce_async, allreduce_async_,
                                        alltoall, broadcast, broadcast_,
                                        broadcast_async, broadcast_async_,
-                                       broadcast_object, poll, synchronize)
+                                       broadcast_object, join, poll,
+                                       synchronize)
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
@@ -28,8 +29,8 @@ __all__ = [
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
     "allgather", "allgather_async", "broadcast", "broadcast_",
     "broadcast_async", "broadcast_async_", "broadcast_object", "alltoall",
-    "poll", "synchronize", "DistributedOptimizer", "broadcast_parameters",
-    "broadcast_optimizer_state",
+    "join", "poll", "synchronize", "DistributedOptimizer",
+    "broadcast_parameters", "broadcast_optimizer_state",
 ]
 
 

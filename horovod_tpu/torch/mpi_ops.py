@@ -93,13 +93,85 @@ def allreduce_async(tensor, average=True, name=None, op=None,
     return TorchHandle(h)
 
 
+# ---- differentiable collectives (reference torch/mpi_ops.py:158-385:
+# the Horovod* autograd Functions let users backprop THROUGH an
+# hvd op, not just reduce gradients) --------------------------------------
+
+class HorovodAllreduce(torch.autograd.Function):
+    """d(allreduce)/dx is another allreduce with the same op and scale
+    factors — both are linear multipliers, so the transpose reuses them
+    (reference mpi_ops.py:158-170)."""
+
+    @staticmethod
+    def forward(ctx, tensor, average, name, op, prescale, postscale):
+        ctx.average = average
+        ctx.op = op
+        ctx.prescale = prescale
+        ctx.postscale = postscale
+        return allreduce_async(tensor, average, name, op,
+                               prescale_factor=prescale,
+                               postscale_factor=postscale).synchronize()
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad = HorovodAllreduce.apply(grad_output, ctx.average, None,
+                                      ctx.op, ctx.prescale, ctx.postscale)
+        return grad, None, None, None, None, None
+
+
+class HorovodAllgather(torch.autograd.Function):
+    """Backward sums the cotangent across ranks, then each rank slices
+    out the rows it contributed (reference mpi_ops.py:289-310)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim = tensor.shape[0]
+        return allgather_async(tensor, name).synchronize()
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = allreduce_async(grad_output,
+                                       average=False).synchronize()
+        dims = allgather_async(
+            torch.tensor([ctx.dim])).synchronize().tolist()
+        r = _core.rank()
+        start = int(sum(dims[:r]))
+        return grad_reduced[start:start + dims[r]], None
+
+
+class HorovodBroadcast(torch.autograd.Function):
+    """Backward sums cotangents onto the root; non-roots contribute
+    their gradient but receive zero (reference mpi_ops.py:371-385)."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return broadcast_async(tensor, root_rank, name).synchronize()
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = allreduce_async(grad_output,
+                                       average=False).synchronize()
+        if _core.rank() != ctx.root_rank:
+            grad_reduced = grad_reduced * 0
+        return grad_reduced, None, None
+
+
 def allreduce(tensor, average=True, name=None, op=None, compression=None,
-              **kw):
+              prescale_factor=1.0, postscale_factor=1.0):
     from horovod_tpu.torch.compression import Compression
     compression = compression or Compression.none
     wire, ctx = compression.compress(tensor)
-    handle = allreduce_async(wire, average=average, name=name, op=op, **kw)
-    out = handle.synchronize()
+    if wire.requires_grad:
+        out = HorovodAllreduce.apply(wire, average,
+                                     _auto_name("allreduce", name),
+                                     op or (Average if average else Sum),
+                                     prescale_factor, postscale_factor)
+    else:
+        out = allreduce_async(wire, average=average, name=name, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor
+                              ).synchronize()
     return compression.decompress(out, ctx)
 
 
@@ -132,6 +204,8 @@ def allgather_async(tensor, name=None):
 
 
 def allgather(tensor, name=None):
+    if tensor.requires_grad:
+        return HorovodAllgather.apply(tensor, _auto_name("allgather", name))
     return allgather_async(tensor, name).synchronize()
 
 
@@ -143,6 +217,9 @@ def broadcast_async(tensor, root_rank, name=None):
 
 
 def broadcast(tensor, root_rank, name=None):
+    if tensor.requires_grad:
+        return HorovodBroadcast.apply(tensor, root_rank,
+                                      _auto_name("broadcast", name))
     return broadcast_async(tensor, root_rank, name).synchronize()
 
 
@@ -169,6 +246,16 @@ def alltoall(tensor, name=None):
     h = _core.alltoall_async(_to_numpy(tensor), _auto_name("alltoall",
                                                            name))
     return TorchHandle(h).synchronize()
+
+
+def join(device=-1):
+    """Announce data exhaustion; blocks until every rank joined and
+    returns the rank that joined LAST (reference torch/mpi_ops.py:494;
+    `device` kept for signature parity — there are no per-device zero
+    buffers to stage on the host plane)."""
+    del device
+    _ensure_core()
+    return _core.join()
 
 
 def poll(handle):

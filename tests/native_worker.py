@@ -163,11 +163,15 @@ def scenario_join(rank, size):
     x = np.ones(4, dtype=np.float32) * (rank + 1)
     core.allreduce(x, "join.step0", op="average")
     if rank >= 2:
-        core.join()
+        last = core.join()
     else:
         out = core.allreduce(x, "join.step1", op="average")
         np.testing.assert_allclose(out, np.ones(4) * 1.5)  # mean of 1,2
-        core.join()
+        last = core.join()
+    # hvd.join() returns the LAST rank to join — one of the stragglers
+    # (0/1), and identical on every rank
+    assert last in (0, 1), last
+    print("JOINLAST", last)
 
 
 def scenario_join_cached(rank, size):

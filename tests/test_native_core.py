@@ -184,7 +184,10 @@ def test_zero_copy_enqueue(size):
 
 
 def test_join_uneven_ranks():
-    _run_workers("join", 4)
+    results = _run_workers("join", 4)
+    last = {l for out, _ in results for l in out.splitlines()
+            if l.startswith("JOINLAST ")}
+    assert len(last) == 1, f"ranks disagree on the last-joined rank: {last}"
 
 
 @pytest.mark.parametrize("size", [3, 4])
