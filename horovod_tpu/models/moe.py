@@ -46,9 +46,11 @@ class MoE(nn.Module):
     d_ff: int
     capacity_factor: float = 2.0
     dtype: Any = jnp.float32
-    # mesh with an ``expert`` axis: activates the sharding constraints
-    # that make GSPMD place the all-to-alls; None = single-device math
+    # mesh with an expert axis (named by ``expert_axis``): activates the
+    # sharding constraints that make GSPMD place the all-to-alls;
+    # None = single-device math
     mesh: Any = None
+    expert_axis: str = "expert"
 
     def _constrain(self, v, spec):
         if self.mesh is None:
@@ -85,14 +87,25 @@ class MoE(nn.Module):
         # gather tokens per expert — GSPMD turns this einsum's output
         # resharding into the forward all-to-all
         expert_in = jnp.einsum("tec,td->ecd", disp, x)
-        expert_in = self._constrain(expert_in, P("expert", None, None))
+        expert_in = self._constrain(expert_in,
+                                    P(self.expert_axis, None, None))
         h = nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
         out_e = jnp.einsum("ecf,efd->ecd", h, w_out)
-        out_e = self._constrain(out_e, P("expert", None, None))
+        out_e = self._constrain(out_e, P(self.expert_axis, None, None))
 
         # route back, weighted by the gate prob (second all-to-all)
         combine = disp * top_prob.astype(x.dtype)[:, None, None]
         return jnp.einsum("tec,ecd->td", combine, out_e)
+
+
+def expert_major_spec(param_path, expert_axis):
+    """The ONE copy of the expert-weight sharding rule (used here and by
+    ``parallel.tensor.transformer_param_specs`` for embedded MoE blocks):
+    returns the spec for an expert-major weight, or None for anything
+    else (gate, norms, ...)."""
+    if param_path.endswith("w_in") or param_path.endswith("w_out"):
+        return P(expert_axis, None, None)
+    return None
 
 
 def moe_param_specs(params, expert_axis="expert"):
@@ -100,9 +113,8 @@ def moe_param_specs(params, expert_axis="expert"):
     over ``expert_axis``, gate replicated."""
     def spec_for(path, leaf):
         names = "/".join(getattr(k, "key", str(k)) for k in path)
-        if names.endswith("w_in") or names.endswith("w_out"):
-            return P(expert_axis, None, None)
-        return P()
+        spec = expert_major_spec(names, expert_axis)
+        return spec if spec is not None else P()
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 

@@ -39,6 +39,14 @@ class TransformerConfig:
     # (ops/flash_attention.py). Requires the default contiguous positions;
     # falls back to plain XLA attention when shapes don't tile.
     flash_attention: bool = False
+    # Switch-style sparse FFN: every `moe_every`-th block (1-based; 0 =
+    # dense everywhere) replaces its MLP with a top-1 MoE of
+    # `num_experts` experts (models/moe.py). `expert_mesh` activates the
+    # expert-parallel sharding constraints over its `expert_axis` axis.
+    moe_every: int = 0
+    num_experts: int = 8
+    expert_mesh: Any = None
+    expert_axis: str = "expert"
 
 
 def _rotary(x, positions):
@@ -106,6 +114,7 @@ class Attention(nn.Module):
 
 class Block(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions, contiguous_positions=False):
@@ -114,9 +123,17 @@ class Block(nn.Module):
         x = x + Attention(cfg, name="attn")(y, positions,
                                             contiguous_positions)
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
-        y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False)(y)
-        y = nn.gelu(y)
-        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=False)(y)
+        if self.use_moe:
+            from horovod_tpu.models.moe import MoE
+            b, s, d = y.shape
+            y = MoE(num_experts=cfg.num_experts, d_model=d,
+                    d_ff=cfg.d_ff, dtype=cfg.dtype, mesh=cfg.expert_mesh,
+                    expert_axis=cfg.expert_axis,
+                    name="moe")(y.reshape(b * s, d)).reshape(b, s, d)
+        else:
+            y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=False)(y)
         return x + y
 
 
@@ -141,7 +158,9 @@ class Transformer(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model,
                      dtype=cfg.dtype, name="embed")(tokens)
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"block_{i}")(x, positions, contiguous)
+            use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+            x = Block(cfg, use_moe=use_moe,
+                      name=f"block_{i}")(x, positions, contiguous)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, use_bias=False,
                           name="lm_head")(x)

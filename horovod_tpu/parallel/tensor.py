@@ -40,16 +40,26 @@ from jax.sharding import PartitionSpec as P
 from horovod_tpu.training import TrainState
 
 
-def transformer_param_specs(params, model_axis="model"):
+def transformer_param_specs(params, model_axis="model", expert_axis=None):
     """Name-rule ``PartitionSpec`` tree for ``models.transformer`` params.
 
-    Anything the rules don't recognize (norm scales, embeddings, biases)
+    ``model_axis=None`` disables the tensor-parallel rules (e.g. an
+    expert-parallel-only mesh); ``expert_axis`` shards embedded MoE
+    expert weights (``cfg.moe_every``) over that axis. Anything the
+    rules don't recognize (norm scales, embeddings, biases, MoE gates)
     is replicated — the safe default for small tensors.
     """
     def spec_for(path, leaf):
         names = [getattr(k, "key", str(k)) for k in path]
         joined = "/".join(names)
         nd = getattr(leaf, "ndim", 0)
+        if expert_axis and "moe/" in joined:
+            from horovod_tpu.models.moe import expert_major_spec
+            spec = expert_major_spec(joined, expert_axis)
+            if spec is not None:
+                return spec                        # one expert per shard
+        if model_axis is None:
+            return P()
         if any(f"{p}/kernel" in joined for p in ("query", "key", "value")):
             return P(None, model_axis, None)       # column: shard heads
         if "out/kernel" in joined and nd == 3:
@@ -65,13 +75,13 @@ def transformer_param_specs(params, model_axis="model"):
 
 
 def shard_lm_state(model, tx, rng, sample_tokens, mesh,
-                   model_axis="model"):
-    """Initialize a TP-sharded ``TrainState``: params placed by the rule
-    shardings, optimizer state initialized UNDER jit so GSPMD propagates
-    the matching layouts onto the moments."""
+                   model_axis="model", expert_axis=None):
+    """Initialize a TP/EP-sharded ``TrainState``: params placed by the
+    rule shardings, optimizer state initialized UNDER jit so GSPMD
+    propagates the matching layouts onto the moments."""
     variables = model.init(rng, sample_tokens)
     params = variables["params"]
-    specs = transformer_param_specs(params, model_axis)
+    specs = transformer_param_specs(params, model_axis, expert_axis)
     shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))
@@ -82,7 +92,8 @@ def shard_lm_state(model, tx, rng, sample_tokens, mesh,
 
 
 def make_tp_lm_train_step(model, tx, mesh, model_axis="model",
-                          batch_axis="data", donate=True):
+                          batch_axis="data", expert_axis=None,
+                          donate=True):
     """Jitted GSPMD language-model train step over a (data x model) mesh.
 
     ``step(state, tokens) -> (state, loss)``: ``tokens [B, S]`` sharded on
@@ -103,7 +114,7 @@ def make_tp_lm_train_step(model, tx, mesh, model_axis="model",
         loss, grads = jax.value_and_grad(compute_loss)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        specs = transformer_param_specs(params, model_axis)
+        specs = transformer_param_specs(params, model_axis, expert_axis)
         params = jax.lax.with_sharding_constraint(
             params, jax.tree_util.tree_map(
                 lambda s: NamedSharding(mesh, s), specs,

@@ -121,22 +121,24 @@ class HorovodAllreduce(torch.autograd.Function):
 
 class HorovodAllgather(torch.autograd.Function):
     """Backward sums the cotangent across ranks, then each rank slices
-    out the rows it contributed (reference mpi_ops.py:289-310)."""
+    out the rows it contributed (reference mpi_ops.py:289-310). Per-rank
+    row counts are gathered once in FORWARD (which already pays a
+    synchronization) and stashed, so backward adds no extra collective
+    round-trip for them."""
 
     @staticmethod
     def forward(ctx, tensor, name):
-        ctx.dim = tensor.shape[0]
+        ctx.dims = allgather_async(
+            torch.tensor([tensor.shape[0]])).synchronize().tolist()
         return allgather_async(tensor, name).synchronize()
 
     @staticmethod
     def backward(ctx, grad_output):
         grad_reduced = allreduce_async(grad_output,
                                        average=False).synchronize()
-        dims = allgather_async(
-            torch.tensor([ctx.dim])).synchronize().tolist()
         r = _core.rank()
-        start = int(sum(dims[:r]))
-        return grad_reduced[start:start + dims[r]], None
+        start = int(sum(ctx.dims[:r]))
+        return grad_reduced[start:start + ctx.dims[r]], None
 
 
 class HorovodBroadcast(torch.autograd.Function):
