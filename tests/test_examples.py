@@ -71,6 +71,14 @@ def test_lm_tensor_parallel_example():
     assert "done" in out
 
 
+def test_lm_moe_example():
+    out = _run([sys.executable, "examples/jax_lm_moe.py",
+                "--steps", "6", "--d-model", "32", "--seq-len", "32"],
+               virtual_mesh=True)
+    assert "w_in sharding: PartitionSpec('expert'" in out
+    assert "done" in out
+
+
 def test_scaling_harness_tiny():
     out = _run([sys.executable, "bench_scaling.py", "--model", "resnet18",
                 "--batch-size", "2", "--image-size", "32",
