@@ -35,6 +35,11 @@ int hvdc_enqueue(int type, const char* name, const void* data,
 // in place (no copy-out — hvdc_output_size reports 0). The caller must
 // keep the buffer alive and unmodified until the handle completes.
 // Reduce-scatter clobbers the buffer as ring scratch.
+// Failure contract: if the collective fails, the borrowed buffer is
+// UNDEFINED — the single-tensor fast path reduces in place (partial
+// results may be visible), while the fused path leaves it untouched;
+// which path a tensor takes depends on what fused that cycle, so
+// callers must treat the data as lost on any non-ok handle status.
 int hvdc_enqueue_borrow(int type, const char* name, void* data,
                         const int64_t* shape, int ndim, int dtype, int op,
                         int root_rank, double prescale, double postscale);

@@ -251,10 +251,12 @@ void ExecuteFusedAllreduce(const Response& resp) {
     int64_t nbytes = resp.tensor_sizes[i] * esz;
     if (have[i]) {
       uint8_t* src = EntryPtr(entries[i]);
-      if (entries[i].prescale != 1.0)
-        ScaleInPlace(src, resp.tensor_sizes[i], resp.dtype,
-                     entries[i].prescale);
       std::memcpy(fused.data() + off, src, nbytes);
+      // prescale inside the fusion buffer, never in the source: a
+      // borrowed caller tensor must stay untouched if the ring fails
+      if (entries[i].prescale != 1.0)
+        ScaleInPlace(fused.data() + off, resp.tensor_sizes[i], resp.dtype,
+                     entries[i].prescale);
       g->copied_bytes.fetch_add(nbytes);
     }
     off += nbytes;

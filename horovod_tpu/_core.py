@@ -129,10 +129,32 @@ def size():
 
 
 # Buffers the core is borrowing, keyed by handle: the registry (not just
-# the Handle object) pins each array until wait()/release, so a caller
+# the Handle object) pins each array until the op completes, so a caller
 # that fires-and-forgets an inplace op can never leave the background
 # loop holding a pointer into freed numpy memory.
 _borrowed_refs = {}
+# C handles whose Python Handle was garbage-collected before completion:
+# their borrow must stay pinned until the background loop is done with
+# the pointer, so they are swept (released + unpinned) from _enqueue once
+# hvdc_poll reports completion. Keeps fire-and-forget callers leak-free.
+_orphaned = set()
+
+
+def _finalize_completed(h):
+    """If handle ``h`` is done, unpin its borrow and release the C
+    handle. Returns True when finalized (single home for the completion
+    protocol: Handle.__del__ and the orphan sweep both go through it)."""
+    if _lib is None or _lib.hvdc_poll(h) == 0:
+        return False
+    _borrowed_refs.pop(h, None)
+    _lib.hvdc_release(h)
+    return True
+
+
+def _sweep_orphans():
+    for h in list(_orphaned):
+        if _finalize_completed(h):
+            _orphaned.discard(h)
 
 
 class Handle:
@@ -153,7 +175,24 @@ class Handle:
 
     def poll(self):
         """True when the op has completed (reference hvd.poll)."""
-        return _lib.hvdc_poll(self._h) != 0
+        done = _lib.hvdc_poll(self._h) != 0
+        if done:
+            # core dropped the raw pointer: the registry pin can go even
+            # if the caller never calls wait() (self._borrowed still
+            # keeps the array alive for wait()'s in-place return)
+            _borrowed_refs.pop(self._h, None)
+        return done
+
+    def __del__(self):
+        if getattr(self, "_released", True):
+            return
+        try:
+            if _lib is not None and not _finalize_completed(self._h):
+                # still in flight: the background loop may hold our
+                # buffer pointer — keep the pin, sweep after completion
+                _orphaned.add(self._h)
+        except Exception:
+            pass  # interpreter shutdown: globals may be gone
 
     def wait(self):
         """Block until done, return the result array (reference
@@ -187,6 +226,7 @@ class Handle:
 def _enqueue(req_type, name, array, op=OP_SUM, root_rank=-1, prescale=1.0,
              postscale=1.0, out_shape=None, inplace=False):
     lib = _load()
+    _sweep_orphans()
     arr = np.ascontiguousarray(array)
     if arr.dtype not in _DTYPE_MAP:
         raise ValueError(f"unsupported dtype {arr.dtype}")
@@ -200,6 +240,11 @@ def _enqueue(req_type, name, array, op=OP_SUM, root_rank=-1, prescale=1.0,
             "inplace=True requires a C-contiguous writable ndarray "
             "(got a copy or read-only view); drop inplace or pass "
             "np.ascontiguousarray(x) yourself and read the result there")
+    # Failure contract for inplace: if the collective fails, the buffer
+    # contents are undefined — the single-tensor fast path may leave it
+    # partially reduced, the fused path untouched (it scales and reduces
+    # in the fusion buffer) — see hvdc_enqueue_borrow in
+    # cxx/include/hvd/operations.h.
     borrow = inplace
     shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
     fn = lib.hvdc_enqueue_borrow if borrow else lib.hvdc_enqueue

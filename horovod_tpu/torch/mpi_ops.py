@@ -106,6 +106,7 @@ class HorovodAllreduce(torch.autograd.Function):
     def forward(ctx, tensor, average, name, op, prescale, postscale):
         ctx.average = average
         ctx.op = op
+        ctx.name = name
         ctx.prescale = prescale
         ctx.postscale = postscale
         return allreduce_async(tensor, average, name, op,
@@ -114,7 +115,8 @@ class HorovodAllreduce(torch.autograd.Function):
 
     @staticmethod
     def backward(ctx, grad_output):
-        grad = HorovodAllreduce.apply(grad_output, ctx.average, None,
+        gname = f"{ctx.name}.grad" if ctx.name is not None else None
+        grad = HorovodAllreduce.apply(grad_output, ctx.average, gname,
                                       ctx.op, ctx.prescale, ctx.postscale)
         return grad, None, None, None, None, None
 
@@ -124,18 +126,28 @@ class HorovodAllgather(torch.autograd.Function):
     out the rows it contributed (reference mpi_ops.py:289-310). Per-rank
     row counts are gathered once in FORWARD (which already pays a
     synchronization) and stashed, so backward adds no extra collective
-    round-trip for them."""
+    round-trip for them.
+
+    All auxiliary collectives are named after the main op
+    (``{name}.dims`` / ``{name}.grad``) rather than auto-numbered, so if
+    ``requires_grad`` differs across ranks for the same logical call the
+    mismatch shows up as a stall on one named tensor that the stall
+    inspector can report — ``requires_grad`` must agree across ranks."""
 
     @staticmethod
     def forward(ctx, tensor, name):
+        ctx.name = name
+        dname = f"{name}.dims" if name is not None else None
         ctx.dims = allgather_async(
-            torch.tensor([tensor.shape[0]])).synchronize().tolist()
+            torch.tensor([tensor.shape[0]]),
+            name=dname).synchronize().tolist()
         return allgather_async(tensor, name).synchronize()
 
     @staticmethod
     def backward(ctx, grad_output):
-        grad_reduced = allreduce_async(grad_output,
-                                       average=False).synchronize()
+        gname = f"{ctx.name}.grad" if ctx.name is not None else None
+        grad_reduced = allreduce_async(
+            grad_output, average=False, name=gname).synchronize()
         r = _core.rank()
         start = int(sum(ctx.dims[:r]))
         return grad_reduced[start:start + ctx.dims[r]], None
@@ -148,12 +160,14 @@ class HorovodBroadcast(torch.autograd.Function):
     @staticmethod
     def forward(ctx, tensor, root_rank, name):
         ctx.root_rank = root_rank
+        ctx.name = name
         return broadcast_async(tensor, root_rank, name).synchronize()
 
     @staticmethod
     def backward(ctx, grad_output):
-        grad_reduced = allreduce_async(grad_output,
-                                       average=False).synchronize()
+        gname = f"{ctx.name}.grad" if ctx.name is not None else None
+        grad_reduced = allreduce_async(
+            grad_output, average=False, name=gname).synchronize()
         if _core.rank() != ctx.root_rank:
             grad_reduced = grad_reduced * 0
         return grad_reduced, None, None

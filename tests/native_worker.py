@@ -333,6 +333,12 @@ def scenario_zerocopy(rank, size):
     core.broadcast_async(np.full(n, float(rank), dtype=np.float32),
                          "zc.ff", root_rank=0, inplace=True)
     core.barrier()  # completes the dropped-handle op safely
+    # once complete, the next enqueue sweeps the orphaned handle: the
+    # borrow registry and handle table must not grow without bound when
+    # callers fire-and-forget (ADVICE r3: eviction not only in wait())
+    core.allreduce(np.ones(4, dtype=np.float32), "zc.sweep", op="sum")
+    assert core._borrowed_refs == {}, core._borrowed_refs
+    assert core._orphaned == set(), core._orphaned
 
 
 def scenario_hierarchy(rank, size):
