@@ -6,6 +6,13 @@ Every 2nd block's MLP is a top-1 mixture-of-experts
 GSPMD inserts the token all-to-alls (``docs/PARALLELISM.md`` — Expert
 parallelism).
 
+The full Switch training recipe is on: the router sows the
+load-balancing auxiliary loss + router z-loss into the ``"losses"``
+collection and ``make_tp_lm_train_step`` adds them to the LM loss
+(weights 0.01 / 1e-3), and token dispatch is grouped
+(``moe_num_groups``) so dispatch memory scales O(T^2/G) instead of
+O(T^2).
+
 Run on the virtual CPU mesh:
     JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -41,7 +48,8 @@ def main():
     cfg = TransformerConfig(vocab_size=256, num_layers=4, num_heads=4,
                             d_model=args.d_model, d_ff=4 * args.d_model,
                             dtype=jnp.float32, moe_every=2,
-                            num_experts=args.num_experts, expert_mesh=mesh)
+                            num_experts=args.num_experts, expert_mesh=mesh,
+                            moe_num_groups=8, moe_group_axis="data")
     model = Transformer(cfg)
     tx = optax.adam(1e-3)
 

@@ -9,22 +9,39 @@ no hand-written collectives.
 
 Routing is switch-style top-1 with a static per-expert capacity C
 (compiler-friendly: every shape static, drops overflow tokens instead of
-dynamic shapes). The dispatch math is the standard one-hot/cumsum
-construction:
+dynamic shapes). Tokens are dispatched in ``num_groups`` independent
+groups (GShard's grouping): the dispatch tensor is ``[G, T/G, E, C]``
+with ``C = ceil(T/G / E * capacity_factor)``, so dispatch memory is
+O(T²·cf/G) instead of O(T²·cf) — at LM scale (T = batch×seq ≈ 32k) the
+un-grouped construction is a memory wall. Per group:
 
-* ``probs [T, E]``      gate softmax
-* ``pos [T, E]``        each token's 1-based position in its expert queue
-* ``disp [T, E, C]``    one-hot dispatch (token t -> slot (e, c))
-* ``expert_in [E,C,d]`` tokens gathered per expert (XLA: all_to_all)
+* ``probs [g, t, E]``      gate softmax
+* ``pos [g, t, E]``        token's 1-based position in its expert queue
+* ``disp [g, t, E, C]``    one-hot dispatch (token t -> slot (e, c))
+* ``expert_in [g,E,C,d]``  tokens gathered per expert (XLA: all_to_all)
 * expert FFN, then the transposed einsum routes results back, weighted
-  by the gate prob (second all_to_all).
+  by the gate prob (second all-to-all).
 
-Because capacity/cumsum are computed over the GLOBAL token dim, the math
-is identical on any mesh — a 1-device run is the oracle for the
-expert-parallel run, which the tests assert.
+Capacity (and the cumsum) is per-group, so the math depends only on
+``(num_groups, capacity_factor)`` — never on the mesh. A 1-device run
+with the same ``num_groups`` is the oracle for the expert-parallel run,
+which the tests assert.
+
+Training recipe (Switch Transformer): top-1 routing collapses onto few
+experts without the load-balancing auxiliary loss, so ``__call__`` sows
+two fp32 scalars into the ``"losses"`` collection:
+
+* ``load_balance``: ``E · Σ_e f_e·P_e`` (fraction of tokens argmax-routed
+  to expert e × mean router prob for e; minimized at uniform routing),
+* ``router_z``: ``mean(logsumexp(logits)²)`` (keeps gate logits small).
+
+Run ``apply(..., mutable=["losses"])`` and add ``aux_loss(mutated)`` to
+the task loss (``parallel.tensor.make_tp_lm_train_step`` does this for
+``TransformerConfig.moe_every`` models). Callers that ignore the
+collection get the plain output — sow is a no-op then.
 """
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -37,20 +54,28 @@ class MoE(nn.Module):
     """Top-1 MoE FFN: ``[T, d_model] -> [T, d_model]``.
 
     ``capacity_factor`` scales per-expert capacity
-    ``C = ceil(T / num_experts * capacity_factor)``; tokens routed past
+    ``C = ceil(T/G / num_experts * capacity_factor)``; tokens routed past
     an expert's capacity pass through with a zero FFN contribution (the
     residual connection around the layer keeps them alive).
+
+    ``num_groups`` splits the tokens into (at most) G independent
+    dispatch groups — the effective count is the largest divisor of T
+    ``<= num_groups``; ``group_axis`` optionally shards the group dim
+    over a mesh axis (typically the data axis) so grouped dispatch
+    composes with DP.
     """
     num_experts: int
     d_model: int
     d_ff: int
     capacity_factor: float = 2.0
+    num_groups: int = 1
     dtype: Any = jnp.float32
     # mesh with an expert axis (named by ``expert_axis``): activates the
     # sharding constraints that make GSPMD place the all-to-alls;
     # None = single-device math
     mesh: Any = None
     expert_axis: str = "expert"
+    group_axis: Optional[str] = None
 
     def _constrain(self, v, spec):
         if self.mesh is None:
@@ -62,7 +87,17 @@ class MoE(nn.Module):
     def __call__(self, x):
         E, d, f = self.num_experts, self.d_model, self.d_ff
         T = x.shape[0]
-        C = max(1, int(-(-T * self.capacity_factor // E)))  # ceil
+        # effective group count: the largest divisor of T <= num_groups.
+        # num_groups is a memory knob (an upper bound), not a contract —
+        # a strict divisibility error would crash init samples whose
+        # B*S differs from the training batch (e.g. shard_lm_state's
+        # batch-1 sample). Deterministic in (T, num_groups), so the
+        # 1-device oracle still matches any mesh run at the same T.
+        G = max(1, min(self.num_groups, T))
+        while T % G != 0:
+            G -= 1
+        t = T // G
+        C = max(1, int(-(-t * self.capacity_factor // E)))  # ceil
 
         gate = self.param("gate", nn.initializers.lecun_normal(), (d, E),
                           self.dtype)
@@ -71,31 +106,64 @@ class MoE(nn.Module):
         w_out = self.param("w_out", nn.initializers.lecun_normal(),
                            (E, f, d), self.dtype)
 
-        probs = jax.nn.softmax((x @ gate).astype(jnp.float32), axis=-1)
-        top1 = jnp.argmax(probs, axis=-1)                       # [T]
-        onehot = jax.nn.one_hot(top1, E, dtype=jnp.float32)     # [T, E]
-        top_prob = jnp.sum(probs * onehot, axis=-1)             # [T]
+        xg = x.reshape(G, t, d)
+        logits = (xg @ gate).astype(jnp.float32)                # [G, t, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        top1 = jnp.argmax(probs, axis=-1)                       # [G, t]
+        onehot = jax.nn.one_hot(top1, E, dtype=jnp.float32)     # [G, t, E]
+        top_prob = jnp.sum(probs * onehot, axis=-1)             # [G, t]
 
-        # 1-based queue position of each token within its expert; tokens
-        # past capacity drop out of the dispatch (static shapes)
-        pos = jnp.cumsum(onehot, axis=0) * onehot               # [T, E]
+        # Switch aux terms, fp32 over ALL tokens pre-capacity (equal-size
+        # groups make the global mean equal the mean of group means)
+        frac = onehot.mean(axis=(0, 1))                         # [E]
+        mean_prob = probs.mean(axis=(0, 1))                     # [E]
+        self.sow("losses", "load_balance", E * jnp.sum(frac * mean_prob))
+        self.sow("losses", "router_z",
+                 jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2))
+
+        # 1-based queue position of each token within its expert, per
+        # group; tokens past capacity drop out of the dispatch (static
+        # shapes)
+        pos = jnp.cumsum(onehot, axis=1) * onehot               # [G, t, E]
         keep = (pos > 0) & (pos <= C)
         disp = jax.nn.one_hot(
             (pos - 1.0).astype(jnp.int32), C,
-            dtype=x.dtype) * keep.astype(x.dtype)[..., None]    # [T, E, C]
+            dtype=x.dtype) * keep.astype(x.dtype)[..., None]    # [G,t,E,C]
 
         # gather tokens per expert — GSPMD turns this einsum's output
         # resharding into the forward all-to-all
-        expert_in = jnp.einsum("tec,td->ecd", disp, x)
-        expert_in = self._constrain(expert_in,
-                                    P(self.expert_axis, None, None))
-        h = nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
-        out_e = jnp.einsum("ecf,efd->ecd", h, w_out)
-        out_e = self._constrain(out_e, P(self.expert_axis, None, None))
+        expert_in = jnp.einsum("gtec,gtd->gecd", disp, xg)      # [G,E,C,d]
+        espec = P(self.group_axis, self.expert_axis, None, None)
+        expert_in = self._constrain(expert_in, espec)
+        h = nn.gelu(jnp.einsum("gecd,edf->gecf", expert_in, w_in))
+        out_e = jnp.einsum("gecf,efd->gecd", h, w_out)
+        out_e = self._constrain(out_e, espec)
 
         # route back, weighted by the gate prob (second all-to-all)
-        combine = disp * top_prob.astype(x.dtype)[:, None, None]
-        return jnp.einsum("tec,ecd->td", combine, out_e)
+        combine = disp * top_prob.astype(x.dtype)[..., None, None]
+        out = jnp.einsum("gtec,gecd->gtd", combine, out_e)
+        return out.reshape(T, d)
+
+
+def aux_loss(mutated, load_balance_weight=0.01, router_z_weight=1e-3):
+    """Scalar auxiliary loss from the collections mutated by ``apply``.
+
+    Accepts either the full mutated-variables dict or its ``"losses"``
+    entry; sums every sown ``load_balance`` / ``router_z`` scalar (one
+    pair per MoE block) with the Switch-paper default weights. Returns
+    fp32 zero when nothing was sown (dense model), so callers can add it
+    unconditionally.
+    """
+    losses = mutated.get("losses", mutated) if hasattr(mutated, "get") \
+        else mutated
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(losses):
+        keys = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "load_balance" in keys:
+            total = total + load_balance_weight * leaf
+        elif "router_z" in keys:
+            total = total + router_z_weight * leaf
+    return total
 
 
 def expert_major_spec(param_path, expert_axis):

@@ -47,6 +47,11 @@ class TransformerConfig:
     num_experts: int = 8
     expert_mesh: Any = None
     expert_axis: str = "expert"
+    # GShard grouped dispatch: tokens split into `moe_num_groups` groups
+    # of (B*S)/G, dispatch memory O(T^2/G); `moe_group_axis` shards the
+    # group dim (usually the data axis) so EP composes with DP
+    moe_num_groups: int = 1
+    moe_group_axis: Optional[str] = None
 
 
 def _rotary(x, positions):
@@ -129,6 +134,8 @@ class Block(nn.Module):
             y = MoE(num_experts=cfg.num_experts, d_model=d,
                     d_ff=cfg.d_ff, dtype=cfg.dtype, mesh=cfg.expert_mesh,
                     expert_axis=cfg.expert_axis,
+                    num_groups=cfg.moe_num_groups,
+                    group_axis=cfg.moe_group_axis,
                     name="moe")(y.reshape(b * s, d)).reshape(b, s, d)
         else:
             y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False)(y)

@@ -93,23 +93,33 @@ def shard_lm_state(model, tx, rng, sample_tokens, mesh,
 
 def make_tp_lm_train_step(model, tx, mesh, model_axis="model",
                           batch_axis="data", expert_axis=None,
-                          donate=True):
+                          donate=True, moe_aux_weight=0.01,
+                          moe_z_weight=1e-3):
     """Jitted GSPMD language-model train step over a (data x model) mesh.
 
     ``step(state, tokens) -> (state, loss)``: ``tokens [B, S]`` sharded on
     ``batch_axis``, ``state`` from ``shard_lm_state``. Exact next-token
     loss; gradients/updates stay in the rule shardings (re-constrained
     after the update so a compiler heuristic can never drift the layout).
+
+    MoE models (``cfg.moe_every``) sow Switch auxiliary terms into the
+    ``"losses"`` collection; they are added here with the given weights
+    (``moe_aux_weight`` load-balance, ``moe_z_weight`` router z-loss) —
+    zero-cost no-op for dense models.
     """
     def step_fn(state, tokens):
         def compute_loss(params):
-            logits = model.apply({"params": params}, tokens)
+            logits, mutated = model.apply({"params": params}, tokens,
+                                          mutable=["losses"])
             targets = tokens[:, 1:]
             logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32),
                                       axis=-1)
             ll = jnp.take_along_axis(logp, targets[..., None],
                                      axis=-1)[..., 0]
-            return -jnp.mean(ll)
+            from horovod_tpu.models.moe import aux_loss
+            return -jnp.mean(ll) + aux_loss(
+                mutated, load_balance_weight=moe_aux_weight,
+                router_z_weight=moe_z_weight)
 
         loss, grads = jax.value_and_grad(compute_loss)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)

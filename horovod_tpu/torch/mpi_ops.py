@@ -42,6 +42,13 @@ def _auto_name(kind, name):
     return f"{kind}.noname.{n}"
 
 
+def _grad_name(name):
+    """Backward-collective name derived from the forward op's name, so a
+    cross-rank mismatch stalls on one named tensor (None falls back to
+    auto-numbering — only reachable via direct .apply with name=None)."""
+    return f"{name}.grad" if name is not None else None
+
+
 class TorchHandle:
     """Wraps a core handle; optionally writes the result back in place.
 
@@ -115,9 +122,9 @@ class HorovodAllreduce(torch.autograd.Function):
 
     @staticmethod
     def backward(ctx, grad_output):
-        gname = f"{ctx.name}.grad" if ctx.name is not None else None
-        grad = HorovodAllreduce.apply(grad_output, ctx.average, gname,
-                                      ctx.op, ctx.prescale, ctx.postscale)
+        grad = HorovodAllreduce.apply(grad_output, ctx.average,
+                                      _grad_name(ctx.name), ctx.op,
+                                      ctx.prescale, ctx.postscale)
         return grad, None, None, None, None, None
 
 
@@ -145,9 +152,9 @@ class HorovodAllgather(torch.autograd.Function):
 
     @staticmethod
     def backward(ctx, grad_output):
-        gname = f"{ctx.name}.grad" if ctx.name is not None else None
         grad_reduced = allreduce_async(
-            grad_output, average=False, name=gname).synchronize()
+            grad_output, average=False,
+            name=_grad_name(ctx.name)).synchronize()
         r = _core.rank()
         start = int(sum(ctx.dims[:r]))
         return grad_reduced[start:start + ctx.dims[r]], None
@@ -165,9 +172,9 @@ class HorovodBroadcast(torch.autograd.Function):
 
     @staticmethod
     def backward(ctx, grad_output):
-        gname = f"{ctx.name}.grad" if ctx.name is not None else None
         grad_reduced = allreduce_async(
-            grad_output, average=False, name=gname).synchronize()
+            grad_output, average=False,
+            name=_grad_name(ctx.name)).synchronize()
         if _core.rank() != ctx.root_rank:
             grad_reduced = grad_reduced * 0
         return grad_reduced, None, None

@@ -10,7 +10,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from horovod_tpu.models.moe import MoE, shard_moe_params
+from horovod_tpu.models.moe import MoE, aux_loss, shard_moe_params
 
 
 def _mesh():
@@ -137,6 +137,124 @@ def test_moe_custom_axis_name(rng):
         {"params": params}, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=2e-5, atol=1e-6)
+
+
+def test_grouped_dispatch_matches_single_device(x):
+    """GShard grouping (num_groups>1): capacity/cumsum are per-group but
+    mesh-independent, so the 1-device run with the same G is still the
+    oracle for the expert-parallel run."""
+    mesh = _mesh()
+    kwargs = dict(num_experts=8, d_model=16, d_ff=32, num_groups=4)
+    oracle = MoE(**kwargs)
+    params = oracle.init(jax.random.PRNGKey(0), x)["params"]
+    want = oracle.apply({"params": params}, x)
+
+    ep = MoE(**kwargs, mesh=mesh)
+    sharded = shard_moe_params(params, mesh)
+    xs = jax.device_put(x, NamedSharding(mesh, P("expert", None)))
+    got = jax.jit(lambda p, v: ep.apply({"params": p}, v))(sharded, xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-6)
+
+
+def test_grouped_dispatch_indivisible_falls_back():
+    """num_groups is an upper bound: T=16 with num_groups=3 uses the
+    largest divisor (2), so an init sample whose B*S doesn't divide the
+    configured G never crashes (the shard_lm_state batch-1 case)."""
+    moe = MoE(num_experts=4, d_model=8, d_ff=16, num_groups=3)
+    x = jnp.ones((16, 8), jnp.float32)
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    out = moe.apply({"params": params}, x)
+    assert out.shape == (16, 8)
+    # effective G=2 equals an explicit num_groups=2 run bit-for-bit
+    want = MoE(num_experts=4, d_model=8, d_ff=16,
+               num_groups=2).apply({"params": params}, x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_grouped_dispatch_memory_scales_down():
+    """The point of grouping: at LM scale (T=32k) the compiled program's
+    temporaries must stay bounded — the un-grouped dispatch tensor alone
+    would be T*E*C = 5.4 GB in fp32; with G=64 it is ~84 MB."""
+    T, E, G = 32768, 8, 64
+    moe = MoE(num_experts=E, d_model=32, d_ff=64, capacity_factor=1.25,
+              num_groups=G)
+    x = jnp.ones((T, 32), jnp.float32)
+    params = jax.eval_shape(
+        lambda: moe.init(jax.random.PRNGKey(0), jnp.ones((64, 32))))
+    params = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), params)["params"]
+    compiled = jax.jit(
+        lambda p, v: moe.apply({"params": p}, v)).lower(params, x).compile()
+    mem = compiled.memory_analysis()
+    if mem is None or not hasattr(mem, "temp_size_in_bytes"):
+        pytest.skip("backend reports no memory analysis")
+    assert mem.temp_size_in_bytes < 1 * 2 ** 30, mem.temp_size_in_bytes
+
+
+def test_aux_loss_sown_and_summed(x):
+    """__call__ sows Switch load-balance + router-z terms; aux_loss sums
+    them with weights; near-uniform routing at init puts load_balance
+    near its minimum of 1.0."""
+    moe = MoE(num_experts=8, d_model=16, d_ff=32)
+    variables = moe.init(jax.random.PRNGKey(0), x)
+    out, mutated = moe.apply({"params": variables["params"]}, x,
+                             mutable=["losses"])
+    losses = mutated["losses"]
+    (lb,) = losses["load_balance"]
+    (z,) = losses["router_z"]
+    assert lb.dtype == jnp.float32 and z.dtype == jnp.float32
+    assert 0.9 <= float(lb) < 4.0, float(lb)   # E * sum(f*p), min 1.0
+    assert float(z) >= 0.0
+    total = aux_loss(mutated, load_balance_weight=0.5, router_z_weight=0.0)
+    np.testing.assert_allclose(float(total), 0.5 * float(lb), rtol=1e-6)
+    # dense path: nothing sown -> exactly zero, so callers can add it
+    # unconditionally
+    assert float(aux_loss({})) == 0.0
+
+
+def test_aux_loss_prevents_collapse():
+    """Train a Switch MoE-LM ~50 steps with the aux loss in the train
+    step (make_tp_lm_train_step wires it); expert utilization must stay
+    spread — no single expert takes the majority of tokens."""
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+    from horovod_tpu.parallel import tensor as tp
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "expert"))
+    cfg = TransformerConfig(vocab_size=32, num_layers=2, num_heads=2,
+                            d_model=16, d_ff=32, dtype=jnp.float32,
+                            moe_every=2, num_experts=8, expert_mesh=mesh,
+                            moe_num_groups=2)
+    model = Transformer(cfg)
+    tx = optax.adam(3e-3)
+    rng = np.random.default_rng(0)
+    # skewed token stream (zipf-ish) — the pressure that collapses
+    # routing when no balancing term exists
+    probs = 1.0 / np.arange(1, 33)
+    probs /= probs.sum()
+    tokens = jnp.asarray(rng.choice(32, size=(8, 16), p=probs), jnp.int32)
+    state = tp.shard_lm_state(model, tx, jax.random.PRNGKey(0),
+                              tokens[:1], mesh, model_axis=None,
+                              expert_axis="expert")
+    step = tp.make_tp_lm_train_step(model, tx, mesh, model_axis=None,
+                                    expert_axis="expert")
+    first = None
+    for _ in range(50):
+        state, loss = step(state, tokens)
+        first = float(loss) if first is None else first
+    assert float(loss) < first, (float(loss), first)
+
+    # measure routing: fraction of tokens argmax-routed to each expert
+    # in the MoE block's gate
+    emb = state.params["embed"]["embedding"]
+    x = emb[np.asarray(tokens).reshape(-1)]
+    gate = state.params["block_1"]["moe"]["gate"]
+    top1 = np.asarray(jnp.argmax(x @ gate, axis=-1))
+    frac = np.bincount(top1, minlength=8) / top1.size
+    assert frac.max() < 0.5, frac        # no majority collapse
+    assert (frac > 0.01).sum() >= 4, frac  # at least half the experts used
 
 
 def test_moe_trains(x):
