@@ -96,6 +96,17 @@ class MoE(nn.Module):
         G = max(1, min(self.num_groups, T))
         while T % G != 0:
             G -= 1
+        if T > 1024 and 2 * G <= self.num_groups:
+            # the divisor fallback quietly reinstated (most of) the
+            # O(T^2) dispatch wall — surface it: at real token counts an
+            # awkward T (prime, 2*prime, ...) deserves a diagnostic, not
+            # a silent compile-time OOM far from this config
+            import warnings
+            warnings.warn(
+                f"MoE grouped dispatch: T={T} has no divisor near "
+                f"num_groups={self.num_groups}; using G={G}. Dispatch "
+                f"memory scales O(T^2/G) — pad/choose batch*seq so it "
+                f"divides by num_groups.", stacklevel=2)
         t = T // G
         C = max(1, int(-(-t * self.capacity_factor // E)))  # ceil
 

@@ -22,11 +22,12 @@ from horovod_tpu.parallel.tensor import (
     shard_lm_state,
     transformer_param_specs,
 )
-from horovod_tpu.parallel.pipeline import pipelined_forward, stack_params
+from horovod_tpu.parallel.pipeline import (pipeline_train_1f1b,
+                                           pipelined_forward, stack_params)
 
 __all__ = [
     "build_mesh", "get_mesh", "set_mesh", "data_axis_names",
     "DATA_AXIS", "DCN_AXIS", "hierarchical_allreduce",
     "make_tp_lm_train_step", "shard_lm_state", "transformer_param_specs",
-    "pipelined_forward", "stack_params",
+    "pipeline_train_1f1b", "pipelined_forward", "stack_params",
 ]
