@@ -990,6 +990,14 @@ static int EnqueueImpl(int type, const char* name, const void* data,
     if (g) g->last_error = "horovod_tpu core is not initialized";
     return -1;
   }
+  // reference parity (test_horovod_broadcast_rank_error): an
+  // out-of-range root must error at enqueue, not hang the ring
+  if (type == static_cast<int>(Request::BROADCAST) &&
+      (root_rank < 0 || root_rank >= g->size)) {
+    g->last_error = "broadcast root rank " + std::to_string(root_rank) +
+                    " is outside [0, " + std::to_string(g->size) + ")";
+    return -1;
+  }
   TensorTableEntry e;
   e.name = name;
   e.type = static_cast<Request::Type>(type);

@@ -1,0 +1,77 @@
+"""MXNet/Gluon MNIST-style training under hvdrun (reference
+``examples/mxnet_mnist.py``): DistributedTrainer, parameter broadcast,
+rank-scaled learning rate — the canonical Horovod Gluon recipe on the
+horovod_tpu host plane.
+
+Run (requires mxnet — present in the real-frameworks CI job, not in the
+Python-3.12 dev image):
+    python -m horovod_tpu.run -np 2 -H localhost:2 \
+        python examples/mxnet_mnist.py --epochs 2
+
+Synthetic MNIST-shaped data keeps it network-free.
+"""
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu.mxnet as hvd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--samples", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    import mxnet as mx
+    from mxnet import autograd, gluon
+
+    hvd.init()
+    ctx = mx.cpu()
+
+    rng = np.random.default_rng(hvd.rank())
+    images = mx.nd.array(
+        rng.normal(size=(args.samples, 1, 28, 28)).astype(np.float32))
+    labels = mx.nd.array(
+        rng.integers(0, 10, size=(args.samples,)).astype(np.float32))
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(channels=8, kernel_size=3, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+
+    params = net.collect_params()
+    # reference recipe: broadcast initial params, scale lr by world size
+    hvd.broadcast_parameters(params, root_rank=0)
+    trainer = hvd.DistributedTrainer(
+        params, "sgd",
+        {"learning_rate": args.lr * hvd.size(), "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    n_batches = args.samples // args.batch_size
+    for epoch in range(args.epochs):
+        total = 0.0
+        for b in range(n_batches):
+            lo = b * args.batch_size
+            x = images[lo:lo + args.batch_size].as_in_context(ctx)
+            y = labels[lo:lo + args.batch_size].as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.mean().asscalar())
+        if hvd.rank() == 0:
+            print(f"epoch {epoch} loss {total / n_batches:.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -287,8 +287,12 @@ def pipeline_train_1f1b(block_fn: Callable[[Any, Any], Any], stacked_params,
             f_mc = jnp.maximum(f_m, 0)
             x_f = jnp.where(s == 0, micro[jnp.clip(f_m, 0, n_micro - 1)],
                             inbox_f[f_mc % n_stages])
+            # last stage's forward output is never consumed (it is not
+            # a ppermute source, and its backward recomputes from
+            # saved_x inside vjp) — skip that dead layer-slice apply
             y_send = jax.lax.cond(
-                f_m >= 0, lambda p, x: _apply_local(block_fn, p, x),
+                (f_m >= 0) & (s < S - 1),
+                lambda p, x: _apply_local(block_fn, p, x),
                 lambda p, x: x, params, x_f)
             saved_x = jnp.where(f_m >= 0,
                                 saved_x.at[f_mc % n_stages].set(x_f),

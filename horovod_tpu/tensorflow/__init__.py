@@ -54,7 +54,8 @@ __all__ = [
     "local_size", "cross_rank", "cross_size",
     "Sum", "Average", "Adasum", "Min", "Max", "Compression",
     "allreduce", "allgather", "broadcast", "broadcast_variables",
-    "broadcast_global_variables", "DistributedGradientTape",
+    "broadcast_global_variables", "BroadcastGlobalVariablesHook",
+    "DistributedGradientTape",
     "DistributedOptimizer",
 ]
 
@@ -115,15 +116,17 @@ _GRAPH_OK = all(hasattr(tf, a) for a in
                 ("numpy_function", "custom_gradient", "executing_eagerly"))
 
 
-def _bridge(host_fn, x, out_shape):
-    """Run ``host_fn(np.ndarray) -> np.ndarray`` on ``x`` in either
-    execution mode: direct in eager, via ``tf.numpy_function`` under
-    ``tf.function`` (the host data plane is CPU-side either way, exactly
-    like the reference's AsyncOpKernel handing the tensor to the
-    background loop)."""
+def _bridge(host_fn, x, out_shape, *extra):
+    """Run ``host_fn(np.ndarray, ...) -> np.ndarray`` on ``x`` (plus any
+    ``extra`` tensors) in either execution mode: direct in eager, via
+    ``tf.numpy_function`` under ``tf.function`` (the host data plane is
+    CPU-side either way, exactly like the reference's AsyncOpKernel
+    handing the tensor to the background loop)."""
     if tf.executing_eagerly():
-        return tf.convert_to_tensor(host_fn(np.asarray(x.numpy())))
-    y = tf.numpy_function(host_fn, [x], x.dtype)
+        return tf.convert_to_tensor(host_fn(
+            np.asarray(x.numpy()),
+            *(np.asarray(e.numpy()) for e in extra)))
+    y = tf.numpy_function(host_fn, [x, *extra], x.dtype)
     y.set_shape(out_shape)
     return y
 
@@ -159,32 +162,32 @@ def _graph_allgather(tensor, name):
     ``HorovodAllgatherGrad``: allreduce-sum the gathered-output gradient,
     then slice out the rows this rank contributed."""
     core = _ensure_core()
-    # local row count + exact input shape, recorded by the forward host
-    # fn so the backward slice matches the input even for 0-d tensors
-    fwd_meta = [None, None]
 
     def _host_fwd(arr):
-        arr = np.asarray(arr)
-        fwd_meta[0] = arr.shape[0] if arr.ndim else 1
-        fwd_meta[1] = arr.shape
-        return np.asarray(core.allgather(arr, name))
+        return np.asarray(core.allgather(np.asarray(arr), name))
 
-    def _host_grad(dy):
+    def _host_grad(dy, xshape):
+        # shape metadata comes from the input's dynamic shape flowing
+        # through THIS execution (a tiny int vector passed as a second
+        # op input), never from trace-time closure state — concurrent
+        # invocations of one traced function each see their own shapes,
+        # and the full forward activation is never retained for it
         dy = np.asarray(dy)
-        nrows, in_shape = fwd_meta
+        xshape = tuple(int(d) for d in np.asarray(xshape))
+        nrows = xshape[0] if xshape else 1
         sizes = np.asarray(core.allgather(
             np.array([nrows], np.int64), name + ".grad.nrows"))
         g = np.asarray(core.allreduce(dy, name + ".grad", op=Sum))
         offset = int(sizes[:rank()].sum())
         return np.ascontiguousarray(
-            g[offset:offset + nrows]).reshape(in_shape)
+            g[offset:offset + nrows]).reshape(xshape)
 
     @tf.custom_gradient
     def _fn(x):
         y = _bridge(_host_fwd, x, [None] + list(x.shape[1:]))
 
         def grad(dy):
-            return _bridge(_host_grad, dy, x.shape)
+            return _bridge(_host_grad, dy, x.shape, tf.shape(x))
         return y, grad
 
     return _fn(tf.convert_to_tensor(tensor))
@@ -298,6 +301,44 @@ def broadcast_global_variables(root_rank=0):
             "(none found); in TF2 call "
             "broadcast_variables(model.variables) instead")
     broadcast_variables(variables, root_rank)
+
+
+_SessionRunHook = object
+if hasattr(tf, "compat") and hasattr(tf.compat.v1, "train"):
+    _SessionRunHook = tf.compat.v1.train.SessionRunHook
+
+
+class BroadcastGlobalVariablesHook(_SessionRunHook):
+    """``tf.compat.v1`` SessionRunHook that broadcasts all global
+    variables from ``root_rank`` right after session creation — the
+    TF1/estimator-era startup sync (reference
+    ``tensorflow/__init__.py:194-227``). Keras/TF2 flows use
+    ``callbacks.BroadcastGlobalVariablesCallback`` instead.
+    """
+
+    def __init__(self, root_rank=0, device=""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.device = device
+        self.bcast_op = None
+
+    def begin(self):
+        graph = tf.compat.v1.get_default_graph()
+        if self.bcast_op is None or self.bcast_op.graph is not graph:
+            import contextlib
+            dev = tf.device(self.device) if self.device \
+                else contextlib.nullcontext()
+            with dev:
+                assigns = [
+                    tf.compat.v1.assign(
+                        v, broadcast(v.read_value(), self.root_rank,
+                                     name=f"bgvh.{i}"))
+                    for i, v in enumerate(
+                        tf.compat.v1.global_variables())]
+                self.bcast_op = tf.group(*assigns)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
 
 
 def _sparse_to_dense(tensor):

@@ -101,3 +101,40 @@ def test_lm_benchmark_tiny():
                 "--vocab", "128", "--seq-len", "512", "--batch", "4"],
                virtual_mesh=True)
     assert "transformer_lm_tokens_per_sec" in out
+
+
+def _has_module(name):
+    import importlib.machinery
+    try:
+        return importlib.machinery.PathFinder.find_spec(name) is not None
+    except (ImportError, ValueError):
+        return False
+
+
+def test_tf_keras_mnist_example_under_hvdrun():
+    """The reference's tensorflow2_keras_mnist CI smoke: 2 processes
+    under hvdrun, DistributedOptimizer + callbacks + rank-0 checkpoint
+    (reference gen-pipeline.sh:127-168 example-run pattern)."""
+    import pytest
+    if not _has_module("tensorflow"):
+        pytest.skip("tensorflow not installed")
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                "-H", "localhost:2", sys.executable,
+                "examples/tensorflow2_keras_mnist.py", "--epochs", "1",
+                "--samples", "64"],
+               extra_env={"TF_CPP_MIN_LOG_LEVEL": "3"}, timeout=600)
+    assert out.count("done") == 2
+    assert "checkpoints: ['ckpt-1.keras']" in out
+
+
+def test_mxnet_mnist_example_under_hvdrun():
+    """The reference's mxnet_mnist CI smoke (runs in the real-mxnet CI
+    job; skipped where mxnet has no wheel, e.g. this py3.12 image)."""
+    import pytest
+    if not _has_module("mxnet"):
+        pytest.skip("mxnet not installed")
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                "-H", "localhost:2", sys.executable,
+                "examples/mxnet_mnist.py", "--epochs", "1",
+                "--samples", "64"], timeout=600)
+    assert out.count("done") == 2

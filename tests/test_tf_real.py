@@ -197,6 +197,270 @@ def test_keras_save_load_model_rewraps():
     assert abs(lr - 0.0125) < 1e-7
 
 
+def test_allreduce_dtype_sweep_and_fused():
+    """Reference test_horovod_allreduce_cpu + _fused (dtype sweep over
+    the full supported set, summed, plus many tensors in flight at
+    once)."""
+    def fn():
+        import numpy as np
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        hvd.init()
+        r, n = hvd.rank(), hvd.size()
+        out = {}
+        dtypes = ["uint8", "int8", "uint16", "int16", "int32", "int64",
+                  "float16", "float32", "float64"]
+        for dt in dtypes:
+            x = tf.constant(np.full((2, 3), r + 1, dtype=dt))
+            s = hvd.allreduce(x, op=hvd.Sum, name=f"sweep.{dt}")
+            assert s.dtype == tf.as_dtype(dt), (dt, s.dtype)
+            out[dt] = np.asarray(s).tolist()
+        # fused: 10 tensors of mixed sizes negotiated together
+        handles = [hvd.allreduce(
+            tf.constant(np.full(i + 1, float(r + i), np.float32)),
+            op=hvd.Sum, name=f"fused.{i}") for i in range(10)]
+        out["fused"] = [np.asarray(h).tolist() for h in handles]
+        # average on floats
+        out["avg"] = np.asarray(hvd.allreduce(
+            tf.constant(np.full(3, float(r + 1), np.float32)),
+            op=hvd.Average, name="sweep.avg")).tolist()
+        return out
+
+    results = api.run(fn, np=2, extra_env=_ENV, timeout=600)
+    total = sum(range(1, 3))  # ranks contribute 1 and 2
+    for res in results:
+        for dt, got in res.items():
+            if dt == "fused":
+                for i, vals in enumerate(got):
+                    np.testing.assert_allclose(
+                        vals, np.full(i + 1, float(i) + float(i + 1)))
+            elif dt == "avg":
+                np.testing.assert_allclose(got, np.full(3, 1.5))
+            else:
+                np.testing.assert_allclose(got, np.full((2, 3), total))
+
+
+def test_allreduce_cross_rank_mismatch_errors():
+    """Reference test_horovod_allreduce_error/_type_error: ranks that
+    disagree on shape (or dtype) for the same tensor name must raise a
+    mismatch error on every rank, not hang."""
+    def fn():
+        import numpy as np
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        hvd.init()
+        r = hvd.rank()
+        out = {}
+        # shape mismatch: rank0 [17], rank1 [17,17]
+        shape = (17,) if r == 0 else (17, 17)
+        try:
+            hvd.allreduce(tf.constant(np.ones(shape, np.float32)),
+                          name="err.shape")
+            out["shape"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["shape"] = str(e)
+        # dtype mismatch: int32 vs float32
+        val = (np.ones(4, np.int32) if r == 0
+               else np.ones(4, np.float32))
+        try:
+            hvd.allreduce(tf.constant(val), name="err.dtype", op=hvd.Sum)
+            out["dtype"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["dtype"] = str(e)
+        return out
+
+    for res in api.run(fn, np=2, extra_env=_ENV, timeout=600):
+        assert "mismatched shapes" in res["shape"], res["shape"]
+        assert "mismatched dtypes" in res["dtype"], res["dtype"]
+
+
+def test_allgather_dtypes_variable_size_and_errors():
+    """Reference test_horovod_allgather(+_variable_size/_error/
+    _type_error): dtype sweep, rank-varying row counts, and cross-rank
+    mismatch errors."""
+    def fn():
+        import numpy as np
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        hvd.init()
+        r = hvd.rank()
+        out = {}
+        for dt in ["uint8", "int32", "int64", "float16", "float32",
+                   "float64"]:
+            x = tf.constant(np.full((2, 2), r + 1, dtype=dt))
+            g = hvd.allgather(x, name=f"ag.{dt}")
+            assert g.dtype == tf.as_dtype(dt)
+            out[dt] = np.asarray(g).tolist()
+        # variable size: rank r contributes r+1 rows
+        xv = tf.constant(np.full((r + 1, 2), float(r), np.float32))
+        out["var"] = np.asarray(
+            hvd.allgather(xv, name="ag.var")).tolist()
+        # trailing-dim mismatch must error (only dim 0 may vary)
+        bad = (np.ones((2, 3), np.float32) if r == 0
+               else np.ones((2, 4), np.float32))
+        try:
+            hvd.allgather(tf.constant(bad), name="ag.err")
+            out["err_shape"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["err_shape"] = str(e)
+        badt = (np.ones(4, np.int32) if r == 0
+                else np.ones(4, np.float32))
+        try:
+            hvd.allgather(tf.constant(badt), name="ag.errt")
+            out["err_dtype"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["err_dtype"] = str(e)
+        return out
+
+    for res in api.run(fn, np=2, extra_env=_ENV, timeout=600):
+        for dt in ["uint8", "int32", "int64", "float16", "float32",
+                   "float64"]:
+            np.testing.assert_allclose(
+                res[dt], np.concatenate([np.full((2, 2), 1),
+                                         np.full((2, 2), 2)]))
+        np.testing.assert_allclose(
+            res["var"], np.concatenate([np.zeros((1, 2)),
+                                        np.ones((2, 2))]))
+        assert "shapes differ beyond the first dim" in res["err_shape"], \
+            res["err_shape"]
+        assert "mismatched dtypes" in res["err_dtype"], res["err_dtype"]
+
+
+def test_broadcast_dtypes_and_rank_errors():
+    """Reference test_horovod_broadcast(+_error/_rank_error): dtype
+    sweep from a non-zero root, out-of-range root raises at enqueue,
+    and cross-rank root disagreement raises a mismatch error."""
+    def fn():
+        import numpy as np
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        hvd.init()
+        r, n = hvd.rank(), hvd.size()
+        out = {}
+        for dt in ["uint8", "int8", "int32", "int64", "float16",
+                   "float32", "float64"]:
+            x = tf.constant(np.full((2, 2), r + 5, dtype=dt))
+            b = hvd.broadcast(x, root_rank=1, name=f"bc.{dt}")
+            assert b.dtype == tf.as_dtype(dt)
+            out[dt] = np.asarray(b).tolist()
+        # out-of-range root: immediate error, same on every rank
+        try:
+            hvd.broadcast(tf.constant(np.ones(2, np.float32)),
+                          root_rank=n, name="bc.oob")
+            out["oob"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["oob"] = str(e)
+        # ranks disagree on the root: negotiation must reject
+        try:
+            hvd.broadcast(tf.constant(np.ones(2, np.float32)),
+                          root_rank=r, name="bc.split")
+            out["split"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["split"] = str(e)
+        return out
+
+    for res in api.run(fn, np=2, extra_env=_ENV, timeout=600):
+        for dt in ["uint8", "int8", "int32", "int64", "float16",
+                   "float32", "float64"]:
+            np.testing.assert_allclose(res[dt], np.full((2, 2), 6))
+        assert "outside" in res["oob"], res["oob"]
+        assert "root" in res["split"], res["split"]
+
+
+def test_gradients_per_dtype():
+    """Reference *_grad_cpu classes: allreduce/allgather/broadcast
+    gradients checked in float16/float32/float64 through real
+    GradientTape."""
+    def fn():
+        import numpy as np
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        hvd.init()
+        r, n = hvd.rank(), hvd.size()
+        out = {}
+        for dt in ["float16", "float32", "float64"]:
+            # allreduce(average): d/dx sum(allreduce(x)) = averaged ones
+            v = tf.Variable(np.ones(3, dtype=dt))
+            with tf.GradientTape() as tape:
+                y = tf.reduce_sum(hvd.allreduce(v, op=hvd.Average,
+                                                name=f"gr.ar.{dt}"))
+            out[f"ar.{dt}"] = tape.gradient(y, v).numpy().tolist()
+
+            # allgather: dy = ones over gathered rows -> allreduce-sum
+            # sliced back = n * ones
+            xg = tf.Variable(np.ones((2, 2), dtype=dt))
+            with tf.GradientTape() as tape:
+                y = tf.reduce_sum(hvd.allgather(xg, name=f"gr.ag.{dt}"))
+            out[f"ag.{dt}"] = tape.gradient(y, xg).numpy().tolist()
+
+            # broadcast: root sums cotangents, others zero
+            vb = tf.Variable(np.ones(2, dtype=dt))
+            with tf.GradientTape() as tape:
+                y = tf.reduce_sum(hvd.broadcast(vb, root_rank=0,
+                                                name=f"gr.bc.{dt}"))
+            out[f"bc.{dt}"] = tape.gradient(y, vb).numpy().tolist()
+        return out
+
+    results = api.run(fn, np=2, extra_env=_ENV, timeout=600)
+    for r, res in enumerate(results):
+        for dt in ["float16", "float32", "float64"]:
+            np.testing.assert_allclose(res[f"ar.{dt}"], np.ones(3))
+            np.testing.assert_allclose(res[f"ag.{dt}"],
+                                       np.full((2, 2), 2.0))
+            np.testing.assert_allclose(
+                res[f"bc.{dt}"],
+                np.full(2, 2.0) if r == 0 else np.zeros(2))
+
+
+def test_broadcast_global_variables_hook_tf1_session():
+    """The TF1/estimator-era BroadcastGlobalVariablesHook (reference
+    tensorflow/__init__.py:194-227): under tf.compat.v1 graph mode +
+    MonitoredSession, ranks that initialize differently come out of
+    session creation with rank 0's values, and broadcast_global_variables
+    works directly on the populated global collection."""
+    def fn():
+        import numpy as np
+        import tensorflow as tf
+        tf.compat.v1.disable_eager_execution()
+        import horovod_tpu.tensorflow as hvd
+        hvd.init()
+        r = hvd.rank()
+
+        v1 = tf.compat.v1.get_variable(
+            "v1", initializer=np.full(3, float(r + 1), np.float32))
+        v2 = tf.compat.v1.get_variable(
+            "v2", initializer=np.full((2, 2), float(10 * (r + 1)),
+                                      np.float32))
+        hook = hvd.BroadcastGlobalVariablesHook(0)
+        with tf.compat.v1.train.MonitoredSession(
+                hooks=[hook]) as sess:
+            a, b = sess.run([v1, v2])
+        return a.tolist(), b.tolist()
+
+    for (a, b) in api.run(fn, np=2, extra_env=_ENV, timeout=600):
+        np.testing.assert_allclose(a, np.full(3, 1.0))
+        np.testing.assert_allclose(b, np.full((2, 2), 10.0))
+
+
+def test_compression_fp16_wire():
+    """Reference test_compression_fp16: fp16 wire compression round-trip
+    preserves dtype and averages correctly."""
+    def fn():
+        import numpy as np
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        hvd.init()
+        r = hvd.rank()
+        x = tf.constant(np.full(8, float(r + 1), np.float32))
+        out = hvd.allreduce(x, op=hvd.Average, name="comp",
+                            compression=hvd.Compression.fp16)
+        assert out.dtype == tf.float32
+        return np.asarray(out).tolist()
+
+    for res in api.run(fn, np=2, extra_env=_ENV, timeout=600):
+        np.testing.assert_allclose(res, np.full(8, 1.5), rtol=1e-3)
+
+
 def test_lr_schedule_callbacks_in_fit():
     """LearningRateScheduleCallback staircase + warmup ramp inside a
     real model.fit (reference _keras/callbacks.py:88-185)."""
