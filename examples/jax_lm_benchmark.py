@@ -74,14 +74,20 @@ def main():
         Transformer(cfg), tx, mesh=mesh, batch_axis="data",
         seq_axis=seq_axis)
 
+    from horovod_tpu.utils.benchmarks import slope_window, sync
     for _ in range(args.warmup):
         state, loss = step(state, tokens)
-        jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, loss = step(state, tokens)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+        sync(loss)
+
+    # readback-slope timing (utils/benchmarks.slope_window: the one copy
+    # of the protocol; block_until_ready does not synchronize through
+    # the async tunnel)
+    def once(carry):
+        st, _ = carry
+        st, loss = step(st, tokens)
+        return (st, loss), loss
+
+    dt, (state, loss) = slope_window(once, (state, loss), args.steps)
 
     tok_s = args.batch * args.seq_len * args.steps / dt
     print(json.dumps({

@@ -60,17 +60,19 @@ def main():
 
     print(f"Model: {args.model}, batch {args.batch_size}/chip x {ndev} "
           f"chips ({platform})")
+    from horovod_tpu.utils.benchmarks import slope_window, sync
     for _ in range(args.num_warmup_batches):
         state, loss = step(state, images, labels)
-    jax.block_until_ready(loss)
+        sync(loss)
 
+    # readback-slope timing per iter (utils/benchmarks.slope_window: the
+    # async tunnel makes block_until_ready-based windows undercount time)
     img_secs = []
     for i in range(args.num_iters):
-        t0 = time.perf_counter()
-        for _ in range(args.num_batches_per_iter):
-            state, loss = step(state, images, labels)
-        jax.block_until_ready(loss)
-        rate = gb * args.num_batches_per_iter / (time.perf_counter() - t0)
+        dt, state = slope_window(
+            lambda st: step(st, images, labels), state,
+            args.num_batches_per_iter, base_iters=1)
+        rate = gb * args.num_batches_per_iter / dt
         img_secs.append(rate)
         print(f"Iter #{i}: {rate:.1f} img/sec total")
     print(f"Img/sec per chip: {np.mean(img_secs) / ndev:.1f} "

@@ -38,7 +38,12 @@ class TransformerConfig:
     # fused Pallas flash-attention kernel for the local (non-ring) path
     # (ops/flash_attention.py). Requires the default contiguous positions;
     # falls back to plain XLA attention when shapes don't tile.
-    flash_attention: bool = False
+    # None (default) = auto: ON when running on TPU with local seq >=
+    # 4096 — the measured crossover on v5e (BENCH_NOTES.md: at seq 2048
+    # XLA's fused dense attention is ~15% faster end-to-end; at 4096
+    # flash wins and dense memory explodes O(S^2)). OFF elsewhere
+    # (interpret mode would crawl). Set True/False to force.
+    flash_attention: Optional[bool] = None
     # Switch-style sparse FFN: every `moe_every`-th block (1-based; 0 =
     # dense everywhere) replaces its MLP with a top-1 MoE of
     # `num_experts` experts (models/moe.py). `expert_mesh` activates the
@@ -92,9 +97,15 @@ class Attention(nn.Module):
         q = _rotary(dense("query")(x), positions)
         k = _rotary(dense("key")(x), positions)
         v = dense("value")(x)
+        use_flash = cfg.flash_attention
+        if use_flash is None:
+            # auto: TPU only, and only past the measured seq crossover
+            # (see TransformerConfig.flash_attention)
+            use_flash = (jax.devices()[0].platform == "tpu"
+                         and x.shape[1] >= 4096)
         if cfg.sequence_axis is not None:
             from horovod_tpu.parallel import ring
-            if cfg.flash_attention and contiguous_positions:
+            if use_flash and contiguous_positions:
                 # Pallas kernel per rotated K/V block, lse-merged
                 out = ring.ring_attention(
                     q, k, v, axis_name=cfg.sequence_axis,
@@ -104,7 +115,7 @@ class Attention(nn.Module):
                     q, k, v, axis_name=cfg.sequence_axis,
                     causal=cfg.causal, q_positions=positions,
                     kv_positions=positions)
-        elif cfg.flash_attention and contiguous_positions:
+        elif use_flash and contiguous_positions:
             # the kernel masks by offset-contiguous positions; arbitrary
             # user-supplied position arrays must use the dense path
             from horovod_tpu.ops import flash_attention as fa

@@ -40,33 +40,73 @@ def synthetic_batch(global_batch, image_size, dtype=None, num_classes=1000,
     return images, labels
 
 
-def repeat_throughput(step, state, images, labels, warmup, iters,
-                      repeats):
-    """``repeats`` back-to-back timed windows over a continuously
-    evolving state (donation-safe: the caller's state is consumed once
-    and threaded through), returning a list of ``(img_per_sec, dt)``.
-    Warmup runs only before the first window — later windows are warm by
-    construction. Each step consumes the previous state, so no two
-    executions are identical and the whole sequence really executes."""
-    runs = []
-    for r in range(repeats):
-        for _ in range(warmup if r == 0 else 0):
-            state, loss = step(state, images, labels)
-            jax.block_until_ready(loss)
+def sync(x):
+    """Force TRUE completion by reading ONE element back to the host.
+
+    ``jax.block_until_ready`` is NOT sufficient through an async
+    execution tunnel (measured round 4: it returned in ~20 us while
+    8192-cubed matmuls were still in flight, inflating throughput ~6x);
+    a host readback cannot complete before the value exists anywhere.
+    The element is sliced on-device first so the readback moves 2-4
+    bytes — transferring a whole buffer would add a size-dependent,
+    cold/warm-varying cost that poisons slope timing.
+    """
+    import jax.numpy as jnp
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(jnp.ravel(leaf)[0])
+
+
+def slope_window(step_once, state, iters, base_iters=2):
+    """THE timing primitive (one copy — every bench path uses it).
+
+    Times ``iters`` iterations by the slope method: run a short
+    ``base_iters`` window and a ``base_iters + iters`` window, each
+    terminated by a forced readback (``sync``), and return their
+    difference. The readback guarantees real completion and its ~100 ms
+    tunnel cost — like every other fixed dispatch cost — cancels in the
+    difference.
+
+    ``step_once(state) -> (state, syncable)`` advances ONE iteration and
+    must thread state so no two calls see identical inputs (the tunnel
+    memoizes pure calls on repeated inputs — BENCH_NOTES.md).
+    Returns ``(dt_for_iters, state)``.
+    """
+    def window(k, st):
+        out = None
         t0 = time.perf_counter()
-        for _ in range(iters):
-            state, loss = step(state, images, labels)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
+        for _ in range(k):
+            st, out = step_once(st)
+        sync(out)
+        return time.perf_counter() - t0, st
+
+    t_base, state = window(base_iters, state)
+    t_full, state = window(base_iters + iters, state)
+    return max(t_full - t_base, 1e-9), state
+
+
+def repeat_throughput(step, state, images, labels, warmup, iters,
+                      repeats, base_iters=2):
+    """``repeats`` slope-timed windows (``slope_window``) over a
+    continuously evolving state (donation-safe: the caller's state is
+    consumed once and threaded through), returning a list of
+    ``(img_per_sec, dt)``. Warmup (first repeat only) covers
+    compilation; later windows are warm by construction."""
+    for _ in range(warmup):
+        state, loss = step(state, images, labels)
+        sync(loss)
+    runs = []
+    for _ in range(repeats):
+        dt, state = slope_window(
+            lambda st: step(st, images, labels), state, iters,
+            base_iters=base_iters)
         runs.append((images.shape[0] * iters / dt, dt))
     return runs
 
 
 def timed_throughput(step, state, images, labels, warmup, iters):
-    """img/s of ``step`` over one timed window (async dispatch, one
-    block at the end — the sequential state dependency makes the final
-    block cover every step). The single-window view of
-    ``repeat_throughput`` so the timing discipline has exactly one
-    copy."""
+    """img/s of ``step`` over one slope-timed window (readback-
+    terminated base + full windows, difference reported — see
+    ``slope_window``). The single-window view of ``repeat_throughput``
+    so the timing discipline has exactly one copy."""
     return repeat_throughput(step, state, images, labels, warmup, iters,
                              repeats=1)[0]

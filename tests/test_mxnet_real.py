@@ -114,3 +114,77 @@ def test_deferred_init_param_broadcasts_at_materialization():
     results = api.run(fn, np=2, extra_env=_ENV, timeout=600)
     for res in results:  # every rank must hold root's all-ones weight
         np.testing.assert_allclose(res, np.ones((2, 3)))
+
+
+def test_dtype_sweep_and_inplace():
+    """Reference test_horovod_allreduce/_inplace + broadcast dtype
+    coverage: the full supported dtype set through real NDArrays, plus
+    the in-place variants."""
+    def fn():
+        import mxnet as mx
+        import numpy as np
+        import horovod_tpu.mxnet as hvd
+        hvd.init()
+        r = hvd.rank()
+        out = {}
+        for dt in ["uint8", "int32", "int64", "float16", "float32",
+                   "float64"]:
+            x = mx.nd.array(np.full((2, 2), r + 1, dtype=dt), dtype=dt)
+            s = hvd.allreduce(x, average=False, name=f"sw.{dt}")
+            assert s.dtype == np.dtype(dt), (dt, s.dtype)
+            out[f"ar.{dt}"] = s.asnumpy().tolist()
+            b = mx.nd.array(np.full(3, r + 5, dtype=dt), dtype=dt)
+            hvd.broadcast_(b, root_rank=1, name=f"bc.{dt}")
+            out[f"bc.{dt}"] = b.asnumpy().tolist()
+        # in-place allreduce writes back into the caller's NDArray
+        y = mx.nd.array(np.full(4, float(r + 1), np.float32))
+        hvd.allreduce_(y, average=True, name="sw.inplace")
+        out["ar_"] = y.asnumpy().tolist()
+        return out
+
+    for res in api.run(fn, np=2, extra_env=_ENV, timeout=600):
+        for dt in ["uint8", "int32", "int64", "float16", "float32",
+                   "float64"]:
+            np.testing.assert_allclose(res[f"ar.{dt}"],
+                                       np.full((2, 2), 3))
+            np.testing.assert_allclose(res[f"bc.{dt}"], np.full(3, 6))
+        np.testing.assert_allclose(res["ar_"], np.full(4, 1.5))
+
+
+def test_cross_rank_mismatch_errors():
+    """Reference test_horovod_allreduce_error/_type_error/
+    _broadcast_rank_error: shape/dtype disagreements and invalid roots
+    must raise, not hang."""
+    def fn():
+        import mxnet as mx
+        import numpy as np
+        import horovod_tpu.mxnet as hvd
+        hvd.init()
+        r, n = hvd.rank(), hvd.size()
+        out = {}
+        shape = (17,) if r == 0 else (17, 17)
+        try:
+            hvd.allreduce(mx.nd.array(np.ones(shape, np.float32)),
+                          name="e.shape")
+            out["shape"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["shape"] = str(e)
+        val = np.ones(4, np.int32) if r == 0 else np.ones(4, np.float32)
+        try:
+            hvd.allreduce(mx.nd.array(val, dtype=val.dtype),
+                          average=False, name="e.dtype")
+            out["dtype"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["dtype"] = str(e)
+        try:
+            hvd.broadcast(mx.nd.array(np.ones(2, np.float32)),
+                          root_rank=n, name="e.root")
+            out["root"] = "NO ERROR"
+        except Exception as e:  # noqa: BLE001
+            out["root"] = str(e)
+        return out
+
+    for res in api.run(fn, np=2, extra_env=_ENV, timeout=600):
+        assert "mismatched shapes" in res["shape"], res["shape"]
+        assert "mismatched dtypes" in res["dtype"], res["dtype"]
+        assert "outside" in res["root"], res["root"]
