@@ -37,7 +37,6 @@ min/max spread.
 import argparse
 import json
 import statistics
-import time
 
 import jax
 import optax
@@ -95,39 +94,22 @@ def calibrate_peak_tflops(repeats=3):
 def lm_tokens_per_sec(flash, *, seq_len=2048, batch=8, layers=12,
                       d_model=768, heads=12, vocab=32000, steps=10,
                       warmup=3, seq_parallel=False):
-    """Single-window LM training throughput (the jax_lm_benchmark.py
-    workload inline: exact sharded LM loss through DistributedOptimizer)."""
-    import jax.numpy as jnp
+    """Single-window LM training throughput (the shared
+    ``make_lm_bench`` workload — exactly what jax_lm_benchmark.py
+    runs)."""
     import numpy as np
 
-    import horovod_tpu as hvd
-    from horovod_tpu import training
-    from horovod_tpu.models.transformer import (Transformer,
-                                                TransformerConfig)
+    from horovod_tpu.utils.benchmarks import (make_lm_bench, slope_window,
+                                              sync)
 
     devs = np.asarray(jax.devices())
     n_seq = devs.size if seq_parallel and devs.size > 1 else 1
     mesh = jax.sharding.Mesh(devs[:n_seq].reshape(1, n_seq),
                              ("data", "seq"))
-    dtype = jnp.bfloat16 if devs[0].platform == "tpu" else jnp.float32
-    seq_axis = "seq" if n_seq > 1 else None
-    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
-                            num_heads=heads, d_model=d_model,
-                            d_ff=4 * d_model, dtype=dtype,
-                            sequence_axis=seq_axis,
-                            flash_attention=flash)
-    init_cfg = TransformerConfig(**{**cfg.__dict__, "sequence_axis": None,
-                                    "flash_attention": False})
-    tx = hvd.DistributedOptimizer(
-        optax.adamw(3e-4), axes=("data", "seq") if seq_axis else ("data",))
-    rng = np.random.default_rng(0)
-    tokens = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)),
-                         jnp.int32)
-    state = training.create_train_state(Transformer(init_cfg), tx,
-                                        jax.random.PRNGKey(0), tokens[:1])
-    step = training.make_lm_train_step(Transformer(cfg), tx, mesh=mesh,
-                                       batch_axis="data", seq_axis=seq_axis)
-    from horovod_tpu.utils.benchmarks import slope_window, sync
+    step, state, tokens = make_lm_bench(
+        mesh=mesh, seq_axis="seq" if n_seq > 1 else None, batch=batch,
+        seq_len=seq_len, layers=layers, d_model=d_model, heads=heads,
+        vocab=vocab, flash=flash)
     for _ in range(warmup):
         state, loss = step(state, tokens)
         sync(loss)

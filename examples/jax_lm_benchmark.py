@@ -16,16 +16,13 @@ Sequence-parallel over a mesh (ring attention, flash per block):
 
 import argparse
 import json
-import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import optax
 
 import horovod_tpu as hvd
-from horovod_tpu import training
-from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from horovod_tpu.utils.benchmarks import (make_lm_bench, slope_window,
+                                          sync)
 
 
 def main():
@@ -51,30 +48,17 @@ def main():
     mesh = jax.sharding.Mesh(devs[:n_used].reshape(args.data, args.seq),
                              ("data", "seq"))
 
-    dtype = (jnp.bfloat16 if devs[0].platform == "tpu" else jnp.float32)
     seq_axis = "seq" if args.seq > 1 else None
-    cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
-                            num_heads=args.heads, d_model=args.d_model,
-                            d_ff=4 * args.d_model, dtype=dtype,
-                            sequence_axis=seq_axis,
-                            flash_attention=not args.no_flash)
-    init_cfg = TransformerConfig(**{**cfg.__dict__, "sequence_axis": None,
-                                    "flash_attention": False})
+    # the ONE copy of the workload (shared with bench.py's LM lines)
+    step, state, tokens = make_lm_bench(
+        mesh=mesh, seq_axis=seq_axis, batch=args.batch,
+        seq_len=args.seq_len, layers=args.layers, d_model=args.d_model,
+        heads=args.heads, vocab=args.vocab, flash=not args.no_flash)
 
-    tx = hvd.DistributedOptimizer(
-        optax.adamw(3e-4),
-        axes=("data", "seq") if seq_axis else ("data",))
-    rng = np.random.default_rng(0)
-    tokens = jnp.asarray(rng.integers(0, args.vocab,
-                                      size=(args.batch, args.seq_len)),
-                         jnp.int32)
-    state = training.create_train_state(Transformer(init_cfg), tx,
-                                        jax.random.PRNGKey(0), tokens[:1])
-    step = training.make_lm_train_step(
-        Transformer(cfg), tx, mesh=mesh, batch_axis="data",
-        seq_axis=seq_axis)
-
-    from horovod_tpu.utils.benchmarks import slope_window, sync
+    # one unconditional warm step (compile + prime the final-loss value;
+    # safe at --warmup 0), then the requested extra warmup
+    state, loss = step(state, tokens)
+    sync(loss)
     for _ in range(args.warmup):
         state, loss = step(state, tokens)
         sync(loss)

@@ -7,7 +7,6 @@ img/sec through the DistributedOptimizer hot path.
 """
 
 import argparse
-import time
 
 import jax
 import jax.numpy as jnp

@@ -126,13 +126,20 @@ def broadcast_optimizer_state(opt_state, root_rank=0, axes=None):
     return broadcast_variables(opt_state, root_rank=root_rank, axes=axes)
 
 
-def allreduce_metrics(metrics, axes=None):
-    """Average scalar metrics across shards at epoch end (reference:
-    ``MetricAverageCallback``, ``horovod/_keras/callbacks.py:46-85``)."""
-    return jax.tree_util.tree_map(
-        lambda x: collective.allreduce(jnp.asarray(x, jnp.float32),
-                                       op=Average, axes=axes),
-        metrics)
+def allreduce_metrics(metrics, axes=None, op=Average):
+    """Reduce scalar metrics across shards at epoch end (reference:
+    ``MetricAverageCallback``, ``horovod/_keras/callbacks.py:46-85``).
+
+    ``op=Average`` (default) matches the reference: every metric becomes
+    an fp32 mean — including int-valued ones (a sample COUNT averaged
+    across shards is a float). Pass ``op=Sum`` for totals: integer
+    leaves then keep their dtype (int counts stay exact ints)."""
+    def one(x):
+        x = jnp.asarray(x)
+        if op == Average or jnp.issubdtype(x.dtype, jnp.floating):
+            x = jnp.asarray(x, jnp.float32)
+        return collective.allreduce(x, op=op, axes=axes)
+    return jax.tree_util.tree_map(one, metrics)
 
 
 def join(grads_tree, is_active, op=Average, axes=None, **fusion_kwargs):

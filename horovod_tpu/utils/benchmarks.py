@@ -81,7 +81,18 @@ def slope_window(step_once, state, iters, base_iters=2):
 
     t_base, state = window(base_iters, state)
     t_full, state = window(base_iters + iters, state)
-    return max(t_full - t_base, 1e-9), state
+    if t_full <= t_base:
+        # jitter inversion (fixed-cost noise exceeded the work): one
+        # retry, then fail loudly — clamping would publish an absurd
+        # multi-billion-rate sample as if it were a measurement
+        t_base, state = window(base_iters, state)
+        t_full, state = window(base_iters + iters, state)
+        if t_full <= t_base:
+            raise RuntimeError(
+                f"slope window inverted twice (base {t_base:.4f}s >= "
+                f"full {t_full:.4f}s over {iters} iters): fixed-cost "
+                f"jitter exceeds the measured work; increase iters")
+    return t_full - t_base, state
 
 
 def repeat_throughput(step, state, images, labels, warmup, iters,
@@ -110,3 +121,42 @@ def timed_throughput(step, state, images, labels, warmup, iters):
     so the timing discipline has exactly one copy."""
     return repeat_throughput(step, state, images, labels, warmup, iters,
                              repeats=1)[0]
+
+
+def make_lm_bench(*, mesh, seq_axis, batch, seq_len, layers, d_model,
+                 heads, vocab, flash, dtype=None, lr=3e-4):
+    """Build the LM benchmark workload ONE way — ``bench.py`` and
+    ``examples/jax_lm_benchmark.py`` share it so their numbers describe
+    the same program: exact sharded LM loss through
+    ``DistributedOptimizer`` on a (data, seq) mesh. Returns
+    ``(step, state, tokens)``; ``flash=None`` means the auto default."""
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import training
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+
+    if dtype is None:
+        dtype = (jnp.bfloat16 if jax.devices()[0].platform == "tpu"
+                 else jnp.float32)
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                            num_heads=heads, d_model=d_model,
+                            d_ff=4 * d_model, dtype=dtype,
+                            sequence_axis=seq_axis,
+                            flash_attention=flash)
+    # init single-device (no seq sharding, no kernel) so params exist
+    # before the sharded step compiles — same trick both callers used
+    init_cfg = TransformerConfig(**{**cfg.__dict__, "sequence_axis": None,
+                                    "flash_attention": False})
+    tx = hvd.DistributedOptimizer(
+        optax.adamw(lr), axes=("data", "seq") if seq_axis else ("data",))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)),
+                         jnp.int32)
+    state = training.create_train_state(Transformer(init_cfg), tx,
+                                        jax.random.PRNGKey(0), tokens[:1])
+    step = training.make_lm_train_step(Transformer(cfg), tx, mesh=mesh,
+                                       batch_axis="data",
+                                       seq_axis=seq_axis)
+    return step, state, tokens

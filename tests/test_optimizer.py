@@ -154,6 +154,21 @@ def test_allreduce_metrics(hvd, n_devices):
     np.testing.assert_allclose(out["acc"], 2 * mean_r)
 
 
+def test_allreduce_metrics_sum_keeps_int_dtype(hvd, n_devices):
+    """op=Sum totals int-valued metrics exactly in their own dtype
+    (sample counts stay ints); Average still yields the fp32 mean."""
+    from horovod_tpu.ops.reduction import Sum
+
+    def f():
+        n = collective.mesh_rank().astype(jnp.int32) + 1
+        return hvd_api.allreduce_metrics({"count": n}, op=Sum)
+
+    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=(),
+                        out_specs={"count": P()}, check_vma=False)()
+    assert out["count"].dtype == jnp.int32
+    assert int(out["count"]) == n_devices * (n_devices + 1) // 2
+
+
 def test_backward_passes_per_step(hvd, n_devices):
     tx = hvd_api.DistributedOptimizer(optax.sgd(1.0),
                                       backward_passes_per_step=2)
