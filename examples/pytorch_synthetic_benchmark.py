@@ -1,0 +1,95 @@
+"""PyTorch synthetic benchmark under hvdrun (reference
+``examples/pytorch_synthetic_benchmark.py`` — the script behind the
+published numbers): timed batches after warmup, img/sec, through
+``horovod_tpu.torch``'s DistributedOptimizer.
+
+The torch adapter is the HOST data plane (CPU tensors through the C++
+ring collectives) — the TPU headline lives in
+``jax_synthetic_benchmark.py``; this script demonstrates and measures
+the torch API surface on the same protocol.
+
+Run:
+    python -m horovod_tpu.run -np 2 -H localhost:2 \
+        python examples/pytorch_synthetic_benchmark.py --num-iters 3
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class SmallNet(nn.Module):
+    """A conv net sized so a CPU-plane benchmark finishes in seconds
+    (``--model resnet50`` via torchvision is the reference config; this
+    default keeps the smoke test torchvision-free)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 16, 3, padding=1)
+        self.conv2 = nn.Conv2d(16, 32, 3, padding=1, stride=2)
+        self.fc = nn.Linear(32 * 16 * 16, 10)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        return self.fc(x.flatten(1))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--num-warmup-batches", type=int, default=2)
+    ap.add_argument("--num-batches-per-iter", type=int, default=5)
+    ap.add_argument("--num-iters", type=int, default=3)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(hvd.rank())
+    model = SmallNet()
+    opt = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size(),
+                          momentum=0.9)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, args.image_size,
+                       args.image_size)
+    target = torch.randint(0, 10, (args.batch_size,))
+
+    def benchmark_step():
+        opt.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        opt.step()
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.perf_counter() - t0
+        rate = args.batch_size * args.num_batches_per_iter / dt
+        img_secs.append(rate)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.1f} img/sec per process")
+    if hvd.rank() == 0:
+        print(f"Img/sec per process: {np.mean(img_secs):.1f} "
+              f"+- {1.96 * np.std(img_secs):.1f}")
+        print(f"Total img/sec on {hvd.size()} processes: "
+              f"{np.mean(img_secs) * hvd.size():.1f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -82,16 +82,22 @@ def slope_window(step_once, state, iters, base_iters=2):
     t_base, state = window(base_iters, state)
     t_full, state = window(base_iters + iters, state)
     if t_full <= t_base:
-        # jitter inversion (fixed-cost noise exceeded the work): one
-        # retry, then fail loudly — clamping would publish an absurd
-        # multi-billion-rate sample as if it were a measurement
+        # jitter inversion (fixed-cost noise exceeded the work): retry
+        # once, then fall back to the FULL window time — an upper bound
+        # including fixed costs, so the published rate can only be
+        # conservative. (Clamping the difference would publish an
+        # absurd multi-billion-rate sample; raising would turn tiny
+        # smoke runs on loaded CI machines into flaky failures.)
         t_base, state = window(base_iters, state)
         t_full, state = window(base_iters + iters, state)
         if t_full <= t_base:
-            raise RuntimeError(
+            import warnings
+            warnings.warn(
                 f"slope window inverted twice (base {t_base:.4f}s >= "
-                f"full {t_full:.4f}s over {iters} iters): fixed-cost "
-                f"jitter exceeds the measured work; increase iters")
+                f"full {t_full:.4f}s over {iters} iters); reporting the "
+                f"full-window upper bound — increase iters for a real "
+                f"measurement", stacklevel=2)
+            return t_full, state
     return t_full - t_base, state
 
 

@@ -138,3 +138,30 @@ def test_mxnet_mnist_example_under_hvdrun():
                 "examples/mxnet_mnist.py", "--epochs", "1",
                 "--samples", "64"], timeout=600)
     assert out.count("done") == 2
+
+
+def test_tf2_custom_loop_example_under_hvdrun():
+    """The reference's tensorflow2_mnist CI smoke: custom GradientTape
+    loop with DistributedGradientTape, post-step-1 variable broadcast,
+    rank-0 checkpoint, weight-digest sync proof."""
+    import pytest
+    if not _has_module("tensorflow"):
+        pytest.skip("tensorflow not installed")
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                "-H", "localhost:2", sys.executable,
+                "examples/tensorflow2_mnist.py", "--steps", "12"],
+               extra_env={"TF_CPP_MIN_LOG_LEVEL": "3"}, timeout=600)
+    assert out.count("done") == 2
+    assert "checkpoint: model.weights.h5" in out
+
+
+def test_pytorch_synthetic_benchmark_under_hvdrun():
+    """The reference's pytorch_synthetic_benchmark CI smoke, 2-proc on
+    the host plane."""
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                "-H", "localhost:2", sys.executable,
+                "examples/pytorch_synthetic_benchmark.py",
+                "--num-iters", "2", "--num-batches-per-iter", "3"],
+               timeout=600)
+    assert out.count("done") == 2
+    assert "Total img/sec on 2 processes" in out
