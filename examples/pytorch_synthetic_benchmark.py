@@ -29,11 +29,11 @@ class SmallNet(nn.Module):
     (``--model resnet50`` via torchvision is the reference config; this
     default keeps the smoke test torchvision-free)."""
 
-    def __init__(self):
+    def __init__(self, image_size=32):
         super().__init__()
         self.conv1 = nn.Conv2d(3, 16, 3, padding=1)
         self.conv2 = nn.Conv2d(16, 32, 3, padding=1, stride=2)
-        self.fc = nn.Linear(32 * 16 * 16, 10)
+        self.fc = nn.Linear(32 * (image_size // 2) ** 2, 10)
 
     def forward(self, x):
         x = F.relu(self.conv1(x))
@@ -52,7 +52,7 @@ def main():
 
     hvd.init()
     torch.manual_seed(hvd.rank())
-    model = SmallNet()
+    model = SmallNet(image_size=args.image_size)
     opt = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size(),
                           momentum=0.9)
     opt = hvd.DistributedOptimizer(

@@ -41,7 +41,7 @@ def main():
     opt = tf.keras.optimizers.SGD(learning_rate=0.01 * hvd.size())
 
     @tf.function
-    def train_step(images, labels, first_batch):
+    def train_step(images, labels):
         with tf.GradientTape() as tape:
             logits = model(images, training=True)
             loss = loss_fn(labels, logits)
@@ -56,12 +56,16 @@ def main():
             size=(args.batch_size, 28, 28, 1)).astype(np.float32))
         labels = tf.constant(rng.integers(
             0, 10, size=(args.batch_size,)).astype(np.int64))
-        loss = train_step(images, labels, step == 0)
+        loss = train_step(images, labels)
         if step == 0:
             # reference: broadcast variables after the first step so
             # late-created slot variables sync too
             hvd.broadcast_variables(model.variables, root_rank=0)
-            hvd.broadcast_variables(opt.variables, root_rank=0)
+            # Keras 3 exposes .variables as a property; legacy Keras 2
+            # optimizers as a method
+            opt_vars = opt.variables() if callable(opt.variables) \
+                else opt.variables
+            hvd.broadcast_variables(opt_vars, root_rank=0)
         if step % 10 == 0 and hvd.rank() == 0:
             print(f"step {step} loss {float(loss):.4f}")
 

@@ -7,20 +7,25 @@ carry ``P('expert', ...)`` shardings, and sharding constraints on the
 dispatched activations make XLA/GSPMD place the token all-to-alls —
 no hand-written collectives.
 
-Routing is switch-style top-1 with a static per-expert capacity C
-(compiler-friendly: every shape static, drops overflow tokens instead of
-dynamic shapes). Tokens are dispatched in ``num_groups`` independent
-groups (GShard's grouping): the dispatch tensor is ``[G, T/G, E, C]``
-with ``C = ceil(T/G / E * capacity_factor)``, so dispatch memory is
-O(T²·cf/G) instead of O(T²·cf) — at LM scale (T = batch×seq ≈ 32k) the
-un-grouped construction is a memory wall. Per group:
+Routing is top-k with a static per-expert capacity C
+(compiler-friendly: every shape static, drops overflow tokens instead
+of dynamic shapes): ``top_k=1`` is Switch (combine weight = raw gate
+prob), ``top_k>=2`` is GShard (weights renormalized over the chosen
+experts; k-th choices queue behind all earlier choices for capacity —
+the GShard yield rule). Tokens are dispatched in ``num_groups``
+independent groups (GShard's grouping): the dispatch tensor is
+``[G, T/G, E, C]`` with ``C = ceil(T/G / E * capacity_factor)``, so
+dispatch memory is O(T²·cf/G) instead of O(T²·cf) — at LM scale
+(T = batch×seq ≈ 32k) the un-grouped construction is a memory wall.
+Per group and per choice k:
 
 * ``probs [g, t, E]``      gate softmax
 * ``pos [g, t, E]``        token's 1-based position in its expert queue
-* ``disp [g, t, E, C]``    one-hot dispatch (token t -> slot (e, c))
+* ``disp [g, t, E, C]``    one-hot dispatch (token t -> slot (e, c)),
+  summed over choices
 * ``expert_in [g,E,C,d]``  tokens gathered per expert (XLA: all_to_all)
-* expert FFN, then the transposed einsum routes results back, weighted
-  by the gate prob (second all-to-all).
+* expert FFN, then the transposed einsum routes results back through
+  the gate-weighted combine tensor (second all-to-all).
 
 Capacity (and the cumsum) is per-group, so the math depends only on
 ``(num_groups, capacity_factor)`` — never on the mesh. A 1-device run
@@ -51,7 +56,7 @@ from jax.sharding import PartitionSpec as P
 
 
 class MoE(nn.Module):
-    """Top-1 MoE FFN: ``[T, d_model] -> [T, d_model]``.
+    """Top-k MoE FFN: ``[T, d_model] -> [T, d_model]``.
 
     ``capacity_factor`` scales per-expert capacity
     ``C = ceil(T/G / num_experts * capacity_factor)``; tokens routed past
@@ -69,6 +74,11 @@ class MoE(nn.Module):
     d_ff: int
     capacity_factor: float = 2.0
     num_groups: int = 1
+    # routing fanout: 1 = Switch (combine weight is the raw gate prob),
+    # >=2 = GShard (weights renormalized over the chosen experts;
+    # later choices queue behind all earlier-choice tokens for
+    # capacity, the GShard yield rule)
+    top_k: int = 1
     dtype: Any = jnp.float32
     # mesh with an expert axis (named by ``expert_axis``): activates the
     # sharding constraints that make GSPMD place the all-to-alls;
@@ -117,29 +127,55 @@ class MoE(nn.Module):
         w_out = self.param("w_out", nn.initializers.lecun_normal(),
                            (E, f, d), self.dtype)
 
+        K = self.top_k
+        if not 1 <= K <= E:
+            raise ValueError(f"top_k={K} must be in [1, {E}]")
+
         xg = x.reshape(G, t, d)
         logits = (xg @ gate).astype(jnp.float32)                # [G, t, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        top1 = jnp.argmax(probs, axis=-1)                       # [G, t]
-        onehot = jax.nn.one_hot(top1, E, dtype=jnp.float32)     # [G, t, E]
-        top_prob = jnp.sum(probs * onehot, axis=-1)             # [G, t]
 
-        # Switch aux terms, fp32 over ALL tokens pre-capacity (equal-size
-        # groups make the global mean equal the mean of group means)
-        frac = onehot.mean(axis=(0, 1))                         # [E]
+        # k-th choice one-hots by iterated masked argmax (K is static)
+        remaining = probs
+        ohs, raw_w = [], []
+        for _ in range(K):
+            choice = jnp.argmax(remaining, axis=-1)             # [G, t]
+            oh = jax.nn.one_hot(choice, E, dtype=jnp.float32)   # [G, t, E]
+            ohs.append(oh)
+            raw_w.append(jnp.sum(probs * oh, axis=-1))          # [G, t]
+            remaining = remaining * (1.0 - oh)
+
+        # aux terms, fp32 over ALL tokens pre-capacity: f_e = fraction
+        # with e as FIRST choice (Switch/GShard), P_e = mean router prob
+        frac = ohs[0].mean(axis=(0, 1))                         # [E]
         mean_prob = probs.mean(axis=(0, 1))                     # [E]
         self.sow("losses", "load_balance", E * jnp.sum(frac * mean_prob))
         self.sow("losses", "router_z",
                  jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2))
 
-        # 1-based queue position of each token within its expert, per
-        # group; tokens past capacity drop out of the dispatch (static
-        # shapes)
-        pos = jnp.cumsum(onehot, axis=1) * onehot               # [G, t, E]
-        keep = (pos > 0) & (pos <= C)
-        disp = jax.nn.one_hot(
-            (pos - 1.0).astype(jnp.int32), C,
-            dtype=x.dtype) * keep.astype(x.dtype)[..., None]    # [G,t,E,C]
+        # combine weights: Switch (K=1) keeps the raw gate prob; GShard
+        # (K>=2) renormalizes over the chosen experts
+        if K == 1:
+            weights = raw_w
+        else:
+            denom = jnp.maximum(sum(raw_w), 1e-9)
+            weights = [w / denom for w in raw_w]
+
+        # per-expert queue positions: k-th choices count AFTER every
+        # earlier choice's tokens (GShard yield rule); past-capacity
+        # tokens drop out of the dispatch (static shapes)
+        base = jnp.zeros((G, 1, E), jnp.float32)
+        disp = jnp.zeros((G, t, E, C), x.dtype)
+        combine = jnp.zeros((G, t, E, C), x.dtype)
+        for oh, w in zip(ohs, weights):
+            pos = (jnp.cumsum(oh, axis=1) + base) * oh          # [G, t, E]
+            keep = (pos > 0) & (pos <= C)
+            d_k = jax.nn.one_hot(
+                (pos - 1.0).astype(jnp.int32), C,
+                dtype=x.dtype) * keep.astype(x.dtype)[..., None]
+            disp = disp + d_k
+            combine = combine + d_k * w.astype(x.dtype)[..., None, None]
+            base = base + jnp.sum(oh, axis=1, keepdims=True)
 
         # gather tokens per expert — GSPMD turns this einsum's output
         # resharding into the forward all-to-all
@@ -150,8 +186,7 @@ class MoE(nn.Module):
         out_e = jnp.einsum("gecf,efd->gecd", h, w_out)
         out_e = self._constrain(out_e, espec)
 
-        # route back, weighted by the gate prob (second all-to-all)
-        combine = disp * top_prob.astype(x.dtype)[..., None, None]
+        # route back, gate-weighted (second all-to-all)
         out = jnp.einsum("gtec,gecd->gtd", combine, out_e)
         return out.reshape(T, d)
 

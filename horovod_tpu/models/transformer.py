@@ -50,6 +50,8 @@ class TransformerConfig:
     # expert-parallel sharding constraints over its `expert_axis` axis.
     moe_every: int = 0
     num_experts: int = 8
+    # routing fanout: 1 = Switch, 2 = GShard top-2 (models/moe.py)
+    moe_top_k: int = 1
     expert_mesh: Any = None
     expert_axis: str = "expert"
     # GShard grouped dispatch: tokens split into `moe_num_groups` groups
@@ -146,7 +148,7 @@ class Block(nn.Module):
                     d_ff=cfg.d_ff, dtype=cfg.dtype, mesh=cfg.expert_mesh,
                     expert_axis=cfg.expert_axis,
                     num_groups=cfg.moe_num_groups,
-                    group_axis=cfg.moe_group_axis,
+                    group_axis=cfg.moe_group_axis, top_k=cfg.moe_top_k,
                     name="moe")(y.reshape(b * s, d)).reshape(b, s, d)
         else:
             y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False)(y)

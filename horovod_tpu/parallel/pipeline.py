@@ -87,7 +87,7 @@ def _pcast_to(x, axes):
 
 def pipelined_forward(block_fn: Callable[[Any, Any], Any], stacked_params,
                       h, *, mesh, axis_name="stage", n_micro=None,
-                      batch_axis=None, param_specs=None):
+                      batch_axis=None, param_specs=None, remat=False):
     """Run ``h`` through the stacked layers as a GPipe pipeline.
 
     ``block_fn(layer_params, x) -> x`` applies ONE layer. ``stacked_params``
@@ -117,11 +117,18 @@ def pipelined_forward(block_fn: Callable[[Any, Any], Any], stacked_params,
     Bubble ticks take a ``lax.cond`` fast path (identity) instead of a
     full layer-stack application, so the (n_stages-1) bubble slots cost
     a branch each rather than compute.
+
+    ``remat=True`` wraps each layer application in ``jax.checkpoint``:
+    the scan saves only per-layer boundaries and recomputes block
+    internals in backward — the knob between GPipe's O(n_micro)
+    full-residual memory and 1F1B's O(n_stages) schedule.
     """
     n_stages = mesh.shape[axis_name]
     if n_micro is None:
         n_micro = n_stages
     _check_shapes(stacked_params, h, mesh, axis_name, n_micro, batch_axis)
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
 
     def inner(params, h):
         n = jax.lax.axis_size(axis_name)
