@@ -33,7 +33,7 @@ class SmallNet(nn.Module):
         super().__init__()
         self.conv1 = nn.Conv2d(3, 16, 3, padding=1)
         self.conv2 = nn.Conv2d(16, 32, 3, padding=1, stride=2)
-        self.fc = nn.Linear(32 * (image_size // 2) ** 2, 10)
+        self.fc = nn.Linear(32 * ((image_size + 1) // 2) ** 2, 10)
 
     def forward(self, x):
         x = F.relu(self.conv1(x))

@@ -39,36 +39,58 @@ def main():
     images = rng.normal(size=(args.samples, 28, 28, 1)).astype(np.float32)
     labels = rng.integers(0, 10, size=(args.samples,)).astype(np.int64)
 
-    model = tf.keras.Sequential([
-        tf.keras.Input(shape=(28, 28, 1)),
-        tf.keras.layers.Conv2D(8, [3, 3], activation="relu"),
-        tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
-        tf.keras.layers.Flatten(),
-        tf.keras.layers.Dense(32, activation="relu"),
-        tf.keras.layers.Dense(10, activation="softmax"),
-    ])
+    # resume conventions (reference keras_imagenet_resnet50.py:102-158):
+    # rank 0 discovers the newest checkpoint epoch from disk, the epoch
+    # number is BROADCAST so every rank agrees, and rank 0's model state
+    # loads from the file (the BroadcastGlobalVariablesCallback then
+    # syncs the weights to everyone)
+    ckpt_dir = os.environ.get("CKPT_DIR", tempfile.mkdtemp())
+    resume_from_epoch = 0
+    if hvd.rank() == 0:
+        for epoch in range(args.epochs, 0, -1):
+            if os.path.exists(os.path.join(ckpt_dir,
+                                           f"ckpt-{epoch}.keras")):
+                resume_from_epoch = epoch
+                break
+    resume_from_epoch = int(hvd.broadcast(
+        tf.constant(resume_from_epoch, tf.int64), root_rank=0,
+        name="resume_from_epoch").numpy())
 
-    # reference recipe: scale lr by world size, wrap the optimizer
-    opt = hvd_keras.DistributedOptimizer(
-        tf.keras.optimizers.SGD(learning_rate=0.01 * hvd.size(),
-                                momentum=0.9))
-    model.compile(optimizer=opt,
-                  loss="sparse_categorical_crossentropy",
-                  metrics=["accuracy"])
+    if resume_from_epoch > 0 and hvd.rank() == 0:
+        model = hvd_keras.load_model(
+            os.path.join(ckpt_dir, f"ckpt-{resume_from_epoch}.keras"))
+        print(f"resuming from epoch {resume_from_epoch}")
+    else:
+        model = tf.keras.Sequential([
+            tf.keras.Input(shape=(28, 28, 1)),
+            tf.keras.layers.Conv2D(8, [3, 3], activation="relu"),
+            tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(32, activation="relu"),
+            tf.keras.layers.Dense(10, activation="softmax"),
+        ])
+        # reference recipe: scale lr by world size, wrap the optimizer
+        opt = hvd_keras.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.01 * hvd.size(),
+                                    momentum=0.9))
+        model.compile(optimizer=opt,
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
 
     callbacks = [BroadcastGlobalVariablesCallback(0),
                  MetricAverageCallback()]
     # rank-0-only checkpointing (SURVEY §5.4 conventions)
-    ckpt_dir = os.environ.get("CKPT_DIR", tempfile.mkdtemp())
     if hvd.rank() == 0:
         callbacks.append(tf.keras.callbacks.ModelCheckpoint(
             os.path.join(ckpt_dir, "ckpt-{epoch}.keras")))
 
     hist = model.fit(images, labels, batch_size=args.batch_size,
                      epochs=args.epochs,
+                     initial_epoch=resume_from_epoch,
                      verbose=1 if hvd.rank() == 0 else 0,
                      callbacks=callbacks)
-    final = hist.history["loss"][-1]
+    losses = hist.history.get("loss", [])
+    final = losses[-1] if losses else float("nan")
     print(f"rank {hvd.rank()} final loss {final:.4f}")
     if hvd.rank() == 0:
         saved = sorted(os.listdir(ckpt_dir))

@@ -44,14 +44,16 @@ class TransformerConfig:
     # flash wins and dense memory explodes O(S^2)). OFF elsewhere
     # (interpret mode would crawl). Set True/False to force.
     flash_attention: Optional[bool] = None
-    # Switch-style sparse FFN: every `moe_every`-th block (1-based; 0 =
-    # dense everywhere) replaces its MLP with a top-1 MoE of
-    # `num_experts` experts (models/moe.py). `expert_mesh` activates the
+    # Sparse-FFN blocks: every `moe_every`-th block (1-based; 0 = dense
+    # everywhere) replaces its MLP with a top-k MoE of `num_experts`
+    # experts (models/moe.py). `expert_mesh` activates the
     # expert-parallel sharding constraints over its `expert_axis` axis.
     moe_every: int = 0
     num_experts: int = 8
-    # routing fanout: 1 = Switch, 2 = GShard top-2 (models/moe.py)
+    # routing fanout: 1 = Switch, 2 = GShard top-2 (models/moe.py);
+    # raise moe_capacity_factor with it (top-k needs ~k slots/token)
     moe_top_k: int = 1
+    moe_capacity_factor: float = 2.0
     expert_mesh: Any = None
     expert_axis: str = "expert"
     # GShard grouped dispatch: tokens split into `moe_num_groups` groups
@@ -149,6 +151,7 @@ class Block(nn.Module):
                     expert_axis=cfg.expert_axis,
                     num_groups=cfg.moe_num_groups,
                     group_axis=cfg.moe_group_axis, top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
                     name="moe")(y.reshape(b * s, d)).reshape(b, s, d)
         else:
             y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False)(y)

@@ -118,13 +118,24 @@ def test_tf_keras_mnist_example_under_hvdrun():
     import pytest
     if not _has_module("tensorflow"):
         pytest.skip("tensorflow not installed")
+    import tempfile
+    ckpt_dir = tempfile.mkdtemp()
+    env = {"TF_CPP_MIN_LOG_LEVEL": "3", "CKPT_DIR": ckpt_dir}
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
                 "-H", "localhost:2", sys.executable,
                 "examples/tensorflow2_keras_mnist.py", "--epochs", "1",
-                "--samples", "64"],
-               extra_env={"TF_CPP_MIN_LOG_LEVEL": "3"}, timeout=600)
+                "--samples", "64"], extra_env=env, timeout=600)
     assert out.count("done") == 2
     assert "checkpoints: ['ckpt-1.keras']" in out
+    # resume conventions: a second run against the same CKPT_DIR must
+    # discover epoch 1, broadcast it, and continue to epoch 2
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                "-H", "localhost:2", sys.executable,
+                "examples/tensorflow2_keras_mnist.py", "--epochs", "2",
+                "--samples", "64"], extra_env=env, timeout=600)
+    assert out.count("done") == 2
+    assert "resuming from epoch 1" in out
+    assert "'ckpt-2.keras'" in out
 
 
 def test_mxnet_mnist_example_under_hvdrun():
