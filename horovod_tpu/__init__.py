@@ -33,7 +33,13 @@ from horovod_tpu.basics import (
     num_devices,
     mesh,
     data_axes,
+    ccl_built,
+    ddl_built,
+    gloo_built,
+    mpi_built,
+    mpi_enabled,
     mpi_threads_supported,
+    nccl_built,
 )
 from horovod_tpu.ops.collective import (
     Sum,
@@ -71,6 +77,8 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "num_devices", "mesh", "data_axes", "mpi_threads_supported",
+    "mpi_built", "mpi_enabled", "gloo_built", "nccl_built",
+    "ddl_built", "ccl_built",
     "Sum", "Average", "Adasum", "Min", "Max",
     "allreduce", "allgather", "broadcast", "reducescatter", "alltoall",
     "mesh_rank", "mesh_size",

@@ -189,3 +189,60 @@ def mpi_threads_supported():
     (``horovod/common/basics.py``): there is no MPI on TPU VMs; the control
     plane is TCP. Always False."""
     return False
+
+
+def mpi_built():
+    """Parity probe (reference ``basics.py:162``): MPI-free by design —
+    the control plane is TCP, the data plane XLA/ICI + host rings."""
+    return False
+
+
+def mpi_enabled():
+    return False
+
+
+def gloo_built():
+    """Parity probe (reference ``basics.py:181``): the role Gloo plays
+    in the reference (TCP collectives without MPI) is filled by the
+    built-in C++ core — True when the native library is present and
+    loadable. Loadability only: a capability probe must never kick off
+    the make-based build (that is ``_core.build()``'s job at init)."""
+    import ctypes
+    import os
+
+    from horovod_tpu import _core
+    if _core._lib is not None:
+        return True
+    if not os.path.exists(_core._LIB_PATH):
+        return False
+    try:
+        ctypes.CDLL(_core._LIB_PATH)
+        return True
+    except OSError:
+        return False
+
+
+def nccl_built():
+    """Parity probe (reference ``basics.py:189``): the "NCCL of TPU" is
+    the XLA/ICI collective path. Returns an int like the reference
+    (which returns the NCCL version code): 0 when no TPU backend is
+    live, 1 otherwise — code that version-gates NCCL-specific features
+    (``nccl_built() >= 21000``) correctly takes its non-NCCL path here,
+    while plain truthiness probes see "built".
+
+    NOTE: when horovod_tpu is not yet initialized this touches
+    ``jax.devices()``, which initializes the local JAX backend — in
+    multi-process pods call it AFTER ``hvd.init()`` (so
+    ``jax.distributed`` initializes first)."""
+    try:
+        return int(any(d.platform == "tpu" for d in jax.devices()))
+    except Exception:
+        return 0
+
+
+def ddl_built():
+    return False
+
+
+def ccl_built():
+    return False

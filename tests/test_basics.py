@@ -81,3 +81,17 @@ def test_config_knobs(monkeypatch):
 
 def test_mpi_threads_supported(hvd):
     assert hvd.mpi_threads_supported() is False
+
+
+def test_built_probes():
+    """Reference *_built() capability probes (basics.py:162-189): the
+    MPI-era backends report absent, the roles that exist here report
+    by their actual availability."""
+    import horovod_tpu as hvd
+    assert hvd.mpi_built() is False
+    assert hvd.mpi_enabled() is False
+    assert hvd.ddl_built() is False
+    assert hvd.ccl_built() is False
+    assert hvd.gloo_built() is True      # native TCP core ships built-in
+    # int like the reference's version-code contract: 0 = no live TPU
+    assert hvd.nccl_built() in (0, 1)
