@@ -145,6 +145,15 @@ Status AllToAll(PeerMesh& mesh, int rank, int size, const void* input,
 Status AdasumAllreduce(PeerMesh& mesh, ControlPlane& control, int rank,
                        int size, void* data, int64_t count, DataType dtype);
 
+// 2-level Adasum (role of AdasumCudaAllreduceOp,
+// adasum_cuda_operations.cc:96-260): intra-host ring reduce-scatter (sum)
+// -> per-chunk Adasum across hosts (power-of-2 host count required) ->
+// intra-host allgather -> divide by local_size (the reference's
+// framework-layer divisor, torch/mpi_ops.py:104-110, folded in).
+Status HierarchicalAdasumAllreduce(PeerMesh& mesh, const Topology& topo,
+                                   void* data, int64_t count,
+                                   DataType dtype);
+
 }  // namespace hvd
 
 #endif  // HVD_CPU_OPS_H

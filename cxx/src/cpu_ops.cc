@@ -434,12 +434,13 @@ struct LevelRecord {
   int64_t start, len;  // fragment kept after the exchange
 };
 
-// recursive-doubling sum of 3 doubles over the aligned group of
-// `group_size` ranks containing `rank`
-Status GroupSumDots(PeerMesh& mesh, int rank, int group_size,
+// recursive-doubling sum of 3 doubles over the aligned subgroup of
+// `group_size` positions containing `grp.pos` (positions, not global
+// ranks — the same schedule runs intra-host or cross-host)
+Status GroupSumDots(PeerMesh& mesh, const Group& grp, int group_size,
                     double dots[3]) {
   for (int e = 1; e < group_size; e <<= 1) {
-    int partner = rank ^ e;
+    int partner = grp.members[grp.pos ^ e];
     double theirs[3];
     Status st = mesh.SendRecv(partner, dots, sizeof(double) * 3, theirs,
                               sizeof(double) * 3);
@@ -450,16 +451,17 @@ Status GroupSumDots(PeerMesh& mesh, int rank, int group_size,
 }
 
 template <typename T>
-Status AdasumT(PeerMesh& mesh, int rank, int size, T* data, int64_t count) {
+Status AdasumT(PeerMesh& mesh, const Group& grp, T* data, int64_t count) {
+  int size = grp.size(), pos = grp.pos;
   std::vector<T> tmp(count);
   std::vector<LevelRecord> stack;
   int64_t start = 0, len = count;
 
   for (int d = 1; d < size; d <<= 1) {
-    int partner = rank ^ d;
+    int partner = grp.members[pos ^ d];
     int64_t low_len = len / 2;
     int64_t high_len = len - low_len;
-    bool keep_low = (rank & d) == 0;
+    bool keep_low = (pos & d) == 0;
     int64_t my_start = keep_low ? start : start + low_len;
     int64_t my_len = keep_low ? low_len : high_len;
     int64_t send_start = keep_low ? start + low_len : start;
@@ -472,10 +474,10 @@ Status AdasumT(PeerMesh& mesh, int rank, int size, T* data, int64_t count) {
                               my_len * sizeof(T));
     if (!st.ok()) return st;
 
-    bool own_is_a = (rank & d) == 0;  // bit-0 side is the "a" group
+    bool own_is_a = (pos & d) == 0;  // bit-0 side is the "a" group
     double dots[3];
     PartialDots(data + my_start, tmp.data(), my_len, own_is_a, dots);
-    st = GroupSumDots(mesh, rank, d << 1, dots);
+    st = GroupSumDots(mesh, grp, d << 1, dots);
     if (!st.ok()) return st;
     Combine(data + my_start, tmp.data(), my_len, own_is_a, dots);
 
@@ -499,28 +501,82 @@ Status AdasumT(PeerMesh& mesh, int rank, int size, T* data, int64_t count) {
   return Status::OK();
 }
 
+Status GroupAdasum(PeerMesh& mesh, const Group& grp, void* data,
+                   int64_t count, DataType dtype) {
+  if (grp.size() == 1) return Status::OK();
+  if ((grp.size() & (grp.size() - 1)) != 0)
+    return Status::InvalidArgument(
+        "Adasum requires a power-of-2 number of ranks (got " +
+        std::to_string(grp.size()) + ")");
+  switch (dtype) {
+    case DataType::FLOAT16:
+      return AdasumT(mesh, grp, static_cast<F16*>(data), count);
+    case DataType::BFLOAT16:
+      return AdasumT(mesh, grp, static_cast<BF16*>(data), count);
+    case DataType::FLOAT32:
+      return AdasumT(mesh, grp, static_cast<float*>(data), count);
+    case DataType::FLOAT64:
+      return AdasumT(mesh, grp, static_cast<double*>(data), count);
+    default:
+      return Status::InvalidArgument("Adasum supports float dtypes only");
+  }
+}
+
 }  // namespace
 
 Status AdasumAllreduce(PeerMesh& mesh, ControlPlane& control, int rank,
                        int size, void* data, int64_t count, DataType dtype) {
   (void)control;
-  if (size == 1) return Status::OK();
-  if ((size & (size - 1)) != 0)
+  return GroupAdasum(mesh, TrivialGroup(rank, size), data, count, dtype);
+}
+
+Status HierarchicalAdasumAllreduce(PeerMesh& mesh, const Topology& topo,
+                                   void* data, int64_t count,
+                                   DataType dtype) {
+  // The reference's production Adasum mode
+  // (adasum_cuda_operations.cc:96-260): intra-node ReduceScatter (sum),
+  // Adasum across nodes run independently on each local rank's chunk
+  // (the reference's cross-node VHDD starts at start_level = local_size,
+  // so each chunk gets its own combine coefficients), intra-node
+  // Allgather. The final 1/local_size is the divisor the reference
+  // applies in its framework layer (torch/mpi_ops.py:104-110); folded in
+  // here so every adapter sees the same user-visible result.
+  if ((topo.cross_size & (topo.cross_size - 1)) != 0)
     return Status::InvalidArgument(
-        "Adasum requires a power-of-2 number of ranks (got " +
-        std::to_string(size) + ")");
+        "hierarchical Adasum requires a power-of-2 number of hosts (got " +
+        std::to_string(topo.cross_size) + ")");
   switch (dtype) {
     case DataType::FLOAT16:
-      return AdasumT(mesh, rank, size, static_cast<F16*>(data), count);
     case DataType::BFLOAT16:
-      return AdasumT(mesh, rank, size, static_cast<BF16*>(data), count);
     case DataType::FLOAT32:
-      return AdasumT(mesh, rank, size, static_cast<float*>(data), count);
     case DataType::FLOAT64:
-      return AdasumT(mesh, rank, size, static_cast<double*>(data), count);
+      break;
     default:
       return Status::InvalidArgument("Adasum supports float dtypes only");
   }
+  size_t esz = DataTypeSize(dtype);
+  Group local = topo.LocalGroup();
+  Chunks ch(count, local.size());
+  std::vector<int64_t> counts(local.size());
+  for (int i = 0; i < local.size(); ++i) counts[i] = ch.len(i);
+
+  // 1. intra-host reduce-scatter (SUM): local rank r owns the host-sum
+  //    of chunk r
+  std::vector<uint8_t> own(counts[topo.local_rank] * esz);
+  Status st = GroupRingReduceScatter(mesh, local, data, counts, dtype,
+                                     ReduceOp::SUM, own.data());
+  if (!st.ok()) return st;
+  // 2. per-chunk Adasum across hosts (every local rank drives its own
+  //    cross tree concurrently, disjoint peer sets)
+  st = GroupAdasum(mesh, topo.CrossGroup(), own.data(),
+                   counts[topo.local_rank], dtype);
+  if (!st.ok()) return st;
+  // 3. intra-host allgather of the combined chunks
+  st = GroupRingAllgatherv(mesh, local, own.data(), counts, dtype, data);
+  if (!st.ok()) return st;
+  // 4. local_size division (reference framework-layer divisor)
+  ScaleInPlace(data, count, dtype, 1.0 / local.size());
+  return Status::OK();
 }
 
 }  // namespace hvd

@@ -195,9 +195,18 @@ uint8_t* EntryPtr(TensorTableEntry& e) {
 // paths: runs in place on `buf`.
 Status RunAllreduce(Response::Type type, uint8_t* buf, int64_t total,
                     DataType dtype, ReduceOp op, int active) {
-  if (type == Response::ADASUM)
+  if (type == Response::ADASUM) {
+    // like the reference, Adasum goes hierarchical whenever the agreed
+    // topology is a real 2-level split (GPU Adasum is ALWAYS the
+    // RS->Adasum->AG composite in the reference, not gated by the
+    // allreduce autotune knob); flat XOR-tree otherwise
+    if (g->hier_capable && g->topo.hierarchical() &&
+        (g->topo.cross_size & (g->topo.cross_size - 1)) == 0)
+      return HierarchicalAdasumAllreduce(*g->mesh, g->topo, buf, total,
+                                         dtype);
     return AdasumAllreduce(*g->mesh, *g->control, g->rank, g->size, buf,
                            total, dtype);
+  }
   // AVERAGE divides by the number of *contributing* (non-joined) ranks
   if (g->hierarchical_allreduce)  // coordinator-agreed at init, never split
     return HierarchicalAllreduce(*g->mesh, g->topo, buf, total, dtype, op,

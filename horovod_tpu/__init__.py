@@ -70,6 +70,7 @@ from horovod_tpu.hvd_jax import (
     join,
 )
 from horovod_tpu import checkpoint
+from horovod_tpu import data
 
 __version__ = "0.1.0"
 
@@ -87,5 +88,5 @@ __all__ = [
     "distributed_grad", "distributed_value_and_grad",
     "broadcast_variables", "broadcast_parameters",
     "broadcast_optimizer_state", "allreduce_metrics", "join",
-    "checkpoint",
+    "checkpoint", "data",
 ]

@@ -57,12 +57,11 @@ def adasum_allreduce(x, axes):
     if isinstance(axes, str):
         axes = (axes,)
     if len(axes) > 1:
-        # Hierarchical variant (adasum_cuda_operations.cc): average over the
-        # inner (ICI) axes first, Adasum across the outer (DCN) axis.
-        outer = axes[0]
-        inner = tuple(axes[1:])
-        x = lax.pmean(x, inner)
-        return adasum_allreduce(x, (outer,))
+        # Hierarchical variant (adasum_cuda_operations.cc): sum-scatter
+        # over the inner (ICI) axes, per-chunk Adasum across the outer
+        # (DCN) axis, all-gather, divide by the inner size.
+        return hierarchical_adasum_allreduce(x, ici_axes=tuple(axes[1:]),
+                                             dcn_axis=axes[0])
     axis = axes[0]
     size = lax.axis_size(axis)
     if size & (size - 1):
@@ -82,6 +81,54 @@ def adasum_allreduce(x, axes):
         a = jnp.where(is_low, out, other)
         b = jnp.where(is_low, other, out)
         out = adasum_combine(a, b)
+    return out
+
+
+def hierarchical_adasum_allreduce(x, ici_axes, dcn_axis,
+                                  divide_by_local_size=True):
+    """The reference's production (2-level) Adasum composition
+    (``adasum_cuda_operations.cc:96-260``): intra-node ReduceScatter (sum)
+    → Adasum across nodes — run **independently per scattered chunk**,
+    exactly like the reference, whose cross-node VHDD starts at
+    ``start_level = local_size`` so each local rank's chunk gets its own
+    combine coefficients — → intra-node Allgather, and finally the
+    ``local_size`` division the reference applies in its framework layer
+    (``torch/mpi_ops.py:104-110`` ``divisor = local_size()``; folded in
+    here so every adapter sees the same user-visible result).
+
+    TPU realization: ``psum_scatter`` over the ICI axes (zero-padded to
+    equal shards — static shapes replace the reference's
+    divisible-fusion-buffer constraint), the XOR-tree ``adasum_allreduce``
+    over the DCN axis on the local chunk, ``all_gather`` back. The DCN
+    axis size must be a power of 2 (reference: "non power of 2 nodes is
+    not supported").
+    """
+    if isinstance(ici_axes, str):
+        ici_axes = (ici_axes,)
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    ici_size = 1
+    for a in ici_axes:
+        ici_size *= lax.axis_size(a)
+    if ici_size == 1:
+        return adasum_allreduce(flat, (dcn_axis,)).reshape(shape)
+    pad = (-n) % ici_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = flat
+    for a in ici_axes:
+        shard = lax.psum_scatter(shard, a, scatter_dimension=0, tiled=True)
+    shard = adasum_allreduce(shard, (dcn_axis,))
+    out = shard
+    for a in reversed(ici_axes):
+        out = lax.all_gather(out, a, axis=0, tiled=True)
+    out = out[:n].reshape(shape)
+    if divide_by_local_size:
+        if jnp.finfo(out.dtype).bits >= 32:
+            out = out / ici_size  # native precision (f64 stays f64)
+        else:  # fp16/bf16: divide in f32 like every other accumulation
+            out = (out.astype(jnp.float32) / ici_size).astype(x.dtype)
     return out
 
 
@@ -115,3 +162,21 @@ def adasum_tree_np(vectors):
         vecs = nxt
         level += 1
     return vecs[0]
+
+
+def hierarchical_adasum_np(grid):
+    """NumPy reference of the 2-level composite for tests: ``grid`` is
+    ``[n_nodes, local_size, n]`` per-rank gradients. Reproduces the TPU
+    schedule exactly — node sums, zero-padded equal-chunk scatter,
+    per-chunk Adasum tree across nodes, concatenate, divide by
+    ``local_size`` — in f32."""
+    grid = np.asarray(grid, np.float32)
+    n_nodes, local_size, n = grid.shape
+    node_sums = grid.sum(axis=1)
+    pad = (-n) % local_size
+    padded = np.pad(node_sums, ((0, 0), (0, pad)))
+    chunks = padded.reshape(n_nodes, local_size, -1)
+    out = np.concatenate([
+        adasum_tree_np([chunks[c, l] for c in range(n_nodes)])
+        for l in range(local_size)])
+    return out[:n] / local_size

@@ -74,6 +74,8 @@ def allreduce(x, op=Average, axes=None, compression=None):
     wire dtype before the collective, mirroring
     ``horovod/torch/compression.py``.
     """
+    if op not in (Sum, Average, Min, Max, Adasum):
+        raise ValueError(f"unknown reduction op: {op!r}")
     axes = _resolve_axes(axes)
     if not _in_named_context(axes):
         return _eager_allreduce(x, op, axes)
@@ -152,11 +154,15 @@ def reducescatter(x, op=Sum, axes=None):
 def alltoall(x, axes=None):
     """Split dim 0 into size chunks, exchange chunk i with shard i, concat
     along dim 0. (Not in Horovod 0.18.2 — added for the sequence-parallel /
-    Ulysses path; Horovod grew hvd.alltoall later.)"""
+    Ulysses path; Horovod grew hvd.alltoall later.)
+
+    Multiple axes are treated as ONE linearized participant set, major
+    axis slowest — chunk i goes to the shard whose ``mesh_rank`` is i,
+    matching every other collective's rank ordering."""
     axes = _resolve_axes(axes)
-    if len(axes) != 1:
-        raise ValueError("alltoall currently supports a single mesh axis")
-    return lax.all_to_all(x, axes[0], split_axis=0, concat_axis=0, tiled=True)
+    if not _in_named_context(axes):
+        return _eager_alltoall(x, axes)
+    return lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)
 
 
 # ---------------------------------------------------------------------------
@@ -222,6 +228,28 @@ def _eager_allreduce(x, op, axes):
     g = _stage_global(x)
     nldev = len(jax.local_devices())
 
+    if op == Adasum:
+        # Staged XOR-tree over the proc mesh. Each process's value sits
+        # replicated on its nldev local devices; since adasum(v, v) = v,
+        # the first log2(nldev) tree levels collapse the duplicates and
+        # the remaining levels perform the true cross-process Adasum —
+        # so running the tree over ALL devices gives exactly the
+        # per-process result (both counts must be powers of 2, the
+        # reference's own Adasum constraint).
+        from horovod_tpu.ops import adasum as adasum_lib
+        ndev = len(jax.devices())
+        if (ndev & (ndev - 1)) or (nldev & (nldev - 1)):
+            raise ValueError(
+                "eager Adasum requires power-of-2 process and "
+                f"local-device counts (got {nproc} x {nldev})")
+        m = _proc_mesh()
+        spec = jax.sharding.PartitionSpec("proc")
+        f = jax.jit(jax.shard_map(
+            lambda t: adasum_lib.adasum_allreduce(t[0], ("proc",)),
+            mesh=m, in_specs=(spec,),
+            out_specs=jax.sharding.PartitionSpec(), check_vma=False))
+        return jax.device_get(f(g))
+
     @jax.jit
     def _reduce(g):
         if op in (Sum, Average):
@@ -255,6 +283,40 @@ def _eager_allgather(x, axes):
         return g[::nldev].reshape((-1,) + g.shape[2:])
 
     return jax.device_get(_gather(g))
+
+
+def _eager_alltoall(x, axes):
+    del axes
+    core = _native_core()
+    if core is not None:
+        return jnp.asarray(core.alltoall(np.asarray(x),
+                                         _eager_name("alltoall")))
+    nproc = _num_processes()
+    if nproc == 1:
+        return jnp.asarray(x)
+    x = jnp.asarray(x)
+    if x.shape[0] % nproc:
+        raise ValueError(
+            f"alltoall dim 0 ({x.shape[0]}) must divide by the process "
+            f"count ({nproc})")
+    g = _stage_global(x)
+    nldev = len(jax.local_devices())
+    m = _proc_mesh()
+
+    # SPMD rule: every process runs the IDENTICAL program (no
+    # process_index inside the trace). All processes compute the full
+    # [P, P, chunk] exchange replicated, then each selects its column on
+    # the host — same shape asymmetry handling as _eager_broadcast.
+    @functools.partial(
+        jax.jit, out_shardings=jax.sharding.NamedSharding(
+            m, jax.sharding.PartitionSpec()))
+    def _exchange(g):
+        h = g[::nldev]  # one contribution per process: [P, n, ...]
+        return h.reshape((nproc, nproc, h.shape[1] // nproc) + h.shape[2:])
+
+    chunks = jax.device_get(_exchange(g))
+    me = jax.process_index()
+    return jnp.asarray(chunks[:, me].reshape((x.shape[0],) + x.shape[1:]))
 
 
 def _eager_broadcast(x, root_rank, axes):

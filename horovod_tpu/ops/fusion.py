@@ -18,7 +18,6 @@ executable.
 """
 
 import dataclasses
-import time
 
 import jax
 import jax.numpy as jnp
@@ -147,12 +146,20 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
     the winner becomes the process-wide default ``fusion_threshold`` used
     by ``fused_allreduce`` / ``DistributedOptimizer``.
 
+    Timing uses the shared readback-slope primitive
+    (``utils.benchmarks.slope_window``) — ``jax.block_until_ready`` does
+    not synchronize through an async execution tunnel, and a repeated
+    pure call on identical inputs can be memoized, so each trial call
+    threads an incrementing ``salt`` operand and the evolving output
+    back in as the next input (BENCH_NOTES.md, "Round-4 correction").
+
     Returns ``(best_threshold_bytes, {threshold: seconds})``.
     """
     from jax.sharding import PartitionSpec as P
 
     from horovod_tpu import basics
     from horovod_tpu.parallel import mesh as mesh_lib
+    from horovod_tpu.utils.benchmarks import slope_window, sync
 
     if candidates is None:
         candidates = [1 << 20, 4 << 20, 16 << 20, 64 << 20]
@@ -164,21 +171,34 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
 
     timings = {}
     for thr in candidates:
-        def f(t, _thr=thr):
+        def f(t, salt, _thr=thr):
+            # salt-shift every leaf: distinct inputs per trial call, and
+            # the reduced output (fed back as the next input) keeps
+            # drifting, so no two calls are memoizable as pure replays.
+            def shift(x):
+                x = jnp.asarray(x)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    return x + (salt * jnp.finfo(jnp.float32).eps).astype(
+                        x.dtype)
+                return x
+            t = jax.tree_util.tree_map(shift, t)
             return fused_allreduce(t, op=op, axes=axes_t,
                                    threshold_bytes=_thr)
         if mesh is not None:
             spec = jax.tree_util.tree_map(lambda _: P(), tree)
-            f = jax.shard_map(f, mesh=mesh, in_specs=(spec,),
+            f = jax.shard_map(f, mesh=mesh, in_specs=(spec, P()),
                               out_specs=spec, check_vma=False)
         jf = jax.jit(f)
-        out = jf(tree)
-        jax.block_until_ready(out)  # compile outside the timed region
-        t0 = time.perf_counter()
-        for _ in range(trials):
-            out = jf(tree)
-        jax.block_until_ready(out)
-        timings[thr] = time.perf_counter() - t0
+        salt0 = jnp.zeros((), jnp.float32)
+        sync(jf(tree, salt0))  # compile + true completion, outside timing
+
+        def step_once(st):
+            t, salt = st
+            out = jf(t, salt)
+            return (out, salt + 1.0), out
+
+        dt, _ = slope_window(step_once, (tree, salt0 + 1.0), trials)
+        timings[thr] = dt
 
     # Multi-process: every rank must install the SAME winner, or ranks
     # would plan different bucket structures and emit mismatched

@@ -240,4 +240,13 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
     def step(state, tokens):
         return jitted(place_repl(state), place_tokens(tokens))
 
+    step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
+
+    def lower(state, tokens):
+        """AOT lower with the SAME placement the executed path uses (one
+        shared compile-cache entry; cost_analysis describes the module
+        that actually runs)."""
+        return jitted.lower(place_repl(state), place_tokens(tokens))
+
+    step.lower = lower
     return step

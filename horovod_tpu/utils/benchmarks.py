@@ -56,6 +56,21 @@ def sync(x):
     return float(jnp.ravel(leaf)[0])
 
 
+class WindowTime(float):
+    """A ``slope_window`` duration. ``upper_bound`` is True when the
+    inverted-window fallback reported the FULL window time (fixed costs
+    included) instead of a slope difference — a conservative bound, not
+    a measurement. Callers that publish medians can count these so
+    bound samples are distinguishable in the reported runs."""
+
+    upper_bound = False
+
+    def __new__(cls, value, upper_bound=False):
+        obj = super().__new__(cls, value)
+        obj.upper_bound = upper_bound
+        return obj
+
+
 def slope_window(step_once, state, iters, base_iters=2):
     """THE timing primitive (one copy — every bench path uses it).
 
@@ -69,7 +84,8 @@ def slope_window(step_once, state, iters, base_iters=2):
     ``step_once(state) -> (state, syncable)`` advances ONE iteration and
     must thread state so no two calls see identical inputs (the tunnel
     memoizes pure calls on repeated inputs — BENCH_NOTES.md).
-    Returns ``(dt_for_iters, state)``.
+    Returns ``(dt_for_iters, state)``; the duration is a ``WindowTime``
+    whose ``upper_bound`` flag marks the inverted-window fallback.
     """
     def window(k, st):
         out = None
@@ -97,8 +113,8 @@ def slope_window(step_once, state, iters, base_iters=2):
                 f"full {t_full:.4f}s over {iters} iters); reporting the "
                 f"full-window upper bound — increase iters for a real "
                 f"measurement", stacklevel=2)
-            return t_full, state
-    return t_full - t_base, state
+            return WindowTime(t_full, upper_bound=True), state
+    return WindowTime(t_full - t_base), state
 
 
 def repeat_throughput(step, state, images, labels, warmup, iters,
@@ -106,8 +122,10 @@ def repeat_throughput(step, state, images, labels, warmup, iters,
     """``repeats`` slope-timed windows (``slope_window``) over a
     continuously evolving state (donation-safe: the caller's state is
     consumed once and threaded through), returning a list of
-    ``(img_per_sec, dt)``. Warmup (first repeat only) covers
-    compilation; later windows are warm by construction."""
+    ``(img_per_sec, dt)`` where ``dt`` is a ``WindowTime`` — check its
+    ``upper_bound`` flag to tell slope measurements from inverted-window
+    conservative bounds. Warmup (first repeat only) covers compilation;
+    later windows are warm by construction."""
     for _ in range(warmup):
         state, loss = step(state, images, labels)
         sync(loss)

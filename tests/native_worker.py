@@ -126,6 +126,38 @@ def scenario_adasum(rank, size):
     np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
 
 
+def scenario_hierarchical_adasum(rank, size):
+    """2-level Adasum under a faked multi-host topology (reference:
+    adasum_cuda_operations.cc): intra-host SUM reduce-scatter ->
+    per-chunk cross-host Adasum tree -> intra-host allgather ->
+    divide by local_size. The oracle reproduces the exact schedule,
+    including the ring chunk layout with its remainder chunks."""
+    L = int(os.environ["HOROVOD_LOCAL_SIZE"])
+    C = size // L
+    n = 41  # not divisible by L: exercises the remainder chunk layout
+    rng = np.random.default_rng(11)
+    grads = rng.standard_normal((size, n)).astype(np.float32)
+    out = core.allreduce(grads[rank], "hadasum.0", op="adasum")
+    # rank = cross_rank * L + local_rank (hvdrun contiguous placement)
+    node_sums = grads.reshape(C, L, n).sum(axis=1)
+    base, rem = divmod(n, L)
+    chunks = []
+    for i in range(L):
+        start = i * base + min(i, rem)
+        ln = base + (1 if i < rem else 0)
+        chunks.append(adasum_ref(
+            [node_sums[c][start:start + ln] for c in range(C)]))
+    expected = np.concatenate(chunks) / L
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    # identical per-rank gradients: node sum = L*g, Adasum(L*g,...) = L*g,
+    # /L = g — the scale-insensitivity that makes local_size (and not
+    # world size) the right divisor (torch/mpi_ops.py:104-110)
+    g_vec = rng.standard_normal(17).astype(np.float32)
+    out = core.allreduce(g_vec, "hadasum.ident", op="adasum")
+    np.testing.assert_allclose(out, g_vec, rtol=1e-5, atol=1e-6)
+
+
 def scenario_errors(rank, size):
     # shape mismatch across ranks -> negotiated error on every rank
     x = np.ones(4 + rank, dtype=np.float32)

@@ -90,13 +90,14 @@ def test_distributed_adasum_via_allreduce_op(hvd, n_devices, rng):
 
 
 def test_hierarchical_adasum_2d(hvd2d, n_devices, rng):
-    """2-D mesh: average within slice ('data'), Adasum across slices
-    ('dcn') — the adasum_cuda_operations.cc structure."""
+    """2-D mesh: the production 2-level composite of
+    adasum_cuda_operations.cc — sum-scatter within slice ('data'),
+    per-chunk Adasum across slices ('dcn'), gather, /local_size —
+    against the NumPy schedule model."""
     data_size = n_devices // 2
     vals = rng.standard_normal((n_devices, 12)).astype(np.float32)
     grid = vals.reshape(2, data_size, 12)
-    slice_means = grid.mean(axis=1)
-    expected = adasum.adasum_tree_np([slice_means[0], slice_means[1]])
+    expected = adasum.hierarchical_adasum_np(grid)
 
     def f():
         x = jnp.asarray(vals)[collective.mesh_rank()]
@@ -106,3 +107,41 @@ def test_hierarchical_adasum_2d(hvd2d, n_devices, rng):
                         check_vma=False)()
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
                                atol=1e-5)
+
+
+def test_hierarchical_adasum_unpadded_chunks(hvd2d, n_devices, rng):
+    """Chunk count not divisible by local_size exercises the zero-pad
+    scatter path (the reference instead constrains its fusion buffer to
+    be divisible by local_size, adasum_cuda_operations.cc:96-116)."""
+    data_size = n_devices // 2
+    n = 4 * data_size + 3  # forces padding
+    vals = rng.standard_normal((n_devices, n)).astype(np.float32)
+    expected = adasum.hierarchical_adasum_np(
+        vals.reshape(2, data_size, n))
+
+    def f():
+        x = jnp.asarray(vals)[collective.mesh_rank()]
+        return adasum.hierarchical_adasum_allreduce(
+            x, ici_axes=("data",), dcn_axis="dcn")
+
+    out = jax.shard_map(f, mesh=hvd2d.mesh(), in_specs=(), out_specs=P(),
+                        check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_hierarchical_adasum_identical_grads_is_identity(hvd2d, n_devices,
+                                                         rng):
+    """Adasum of identical node-gradients returns the per-rank gradient:
+    node sum = L*g, adasum(L*g, L*g) = L*g, /L = g — the scale-insensitive
+    property the local_size division preserves (the reason the reference
+    divides by local_size and NOT world size, torch/mpi_ops.py:104-110)."""
+    g_vec = rng.standard_normal(16).astype(np.float32)
+
+    def f():
+        return adasum.adasum_allreduce(jnp.asarray(g_vec), ("dcn", "data"))
+
+    out = jax.shard_map(f, mesh=hvd2d.mesh(), in_specs=(), out_specs=P(),
+                        check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out), g_vec, rtol=1e-5,
+                               atol=1e-6)

@@ -158,11 +158,54 @@ def test_2d_mesh_single_axis_reduce(hvd2d, n_devices):
 
 
 def test_eager_single_process_semantics(hvd):
-    # One launched process => Horovod world of size 1 => identity.
+    # One launched process => Horovod world of size 1 => identity,
+    # for every reduction op including Adasum (eager-surface uniformity).
     x = np.arange(6.0, dtype=np.float32).reshape(2, 3)
     np.testing.assert_allclose(hvd.allreduce(x), x)
+    for op in (hvd_api.Sum, hvd_api.Average, hvd_api.Min, hvd_api.Max,
+               hvd_api.Adasum):
+        np.testing.assert_allclose(hvd.allreduce(x, op=op), x)
     np.testing.assert_allclose(hvd.allgather(x), x)
     np.testing.assert_allclose(hvd.broadcast(x, root_rank=0), x)
+    np.testing.assert_allclose(hvd.alltoall(x), x)
+
+
+def test_eager_adasum_duplicate_collapse(hvd, n_devices, rng):
+    """Correctness basis of the staged eager Adasum path
+    (collective._eager_allreduce): each process's value is replicated on
+    its local devices, and since adasum(v, v) = v the first tree levels
+    collapse the duplicates — the all-device XOR tree equals the tree
+    over unique per-process values."""
+    from horovod_tpu.ops import adasum
+    nproc = n_devices // 2
+    vals = rng.standard_normal((nproc, 9)).astype(np.float32)
+    dup = np.repeat(vals, 2, axis=0)  # device-major staging layout
+
+    def f():
+        x = jnp.asarray(dup)[collective.mesh_rank()]
+        return adasum.adasum_allreduce(x, ("data",))
+
+    out = shard_apply(hvd, f)
+    expected = adasum.adasum_tree_np([vals[i] for i in range(nproc)])
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_alltoall_multi_axis(hvd2d, n_devices):
+    """alltoall over BOTH mesh axes: the participant set is the
+    linearized (dcn, data) rank order, matching mesh_rank."""
+    def f():
+        me = collective.mesh_rank(("dcn", "data")).astype(jnp.float32)
+        x = me * jnp.ones((n_devices,)) + jnp.arange(n_devices) * 0.1
+        out = collective.alltoall(x, axes=("dcn", "data"))
+        return collective.allgather(out[None], axes=("dcn", "data"))
+
+    out = jax.shard_map(f, mesh=hvd2d.mesh(), in_specs=(),
+                        out_specs=P(("dcn", "data")), check_vma=False)()
+    out = np.asarray(out)
+    for j in range(n_devices):
+        np.testing.assert_allclose(
+            out[j], np.arange(n_devices) + 0.1 * j, rtol=1e-6)
 
 
 def test_hierarchical_allreduce_matches_flat(hvd2d, n_devices):

@@ -140,6 +140,58 @@ def test_autotune_fusion_threshold(hvd):
     np.testing.assert_allclose(out["a"], 8.0 * np.ones((512,)), rtol=1e-6)
 
 
+def test_autotune_uses_shared_timing_primitive(hvd, monkeypatch):
+    """The autotuner must time through utils.benchmarks.slope_window
+    (the readback-slope protocol) — block_until_ready does not
+    synchronize through the async tunnel (BENCH_NOTES.md r4) — and must
+    thread a fresh salt into every trial call so the tunnel's pure-call
+    memoization cannot serve a cached result."""
+    from horovod_tpu.utils import benchmarks
+
+    calls = {"n": 0, "salts": []}
+    real = benchmarks.slope_window
+
+    def spying(step_once, state, iters, base_iters=2):
+        calls["n"] += 1
+
+        def spy_step(st):
+            calls["salts"].append(float(st[1]))
+            return step_once(st)
+
+        return real(spy_step, state, iters, base_iters=base_iters)
+
+    monkeypatch.setattr(benchmarks, "slope_window", spying)
+    tree = {"a": jnp.ones((64,))}
+    fusion.autotune_fusion_threshold(tree, candidates=[1 << 10, 1 << 20],
+                                     trials=2, apply=False)
+    assert calls["n"] == 2  # one slope window per candidate
+    # every trial call saw a distinct salt (fresh inputs, no memoization)
+    per_candidate = len(calls["salts"]) // 2
+    for i in range(2):
+        salts = calls["salts"][i * per_candidate:(i + 1) * per_candidate]
+        assert len(set(salts)) == len(salts)
+
+
+def test_no_block_until_ready_in_package():
+    """Round-4 lesson, enforced: jax.block_until_ready does not
+    synchronize through an async execution tunnel, so NO code in the
+    package may use it for timing or completion. The only allowed
+    mention is the benchmarks.py docstring that documents the gotcha."""
+    import pathlib
+
+    import horovod_tpu
+
+    pkg = pathlib.Path(horovod_tpu.__file__).parent
+    offenders = []
+    for path in pkg.rglob("*.py"):
+        text = path.read_text()
+        if "block_until_ready(" in text:
+            offenders.append(str(path.relative_to(pkg)))
+    assert offenders == [], (
+        f"block_until_ready call found in {offenders}; use "
+        "utils.benchmarks.sync/slope_window instead")
+
+
 def test_one_collective_per_bucket(hvd):
     """The fused path must emit exactly one all-reduce per dtype bucket
     (the whole point of fusion — reference fuses to one NCCL call per

@@ -57,6 +57,17 @@ def test_adasum_matches_numpy_reference(size):
     _run_workers("adasum", size)
 
 
+@pytest.mark.parametrize("size,local_size", [(4, 2), (8, 2), (8, 4)])
+def test_hierarchical_adasum_matches_schedule_model(size, local_size):
+    """op=adasum under an agreed 2-level topology takes the
+    RS -> per-chunk Adasum -> AG -> /local_size composite
+    (adasum_cuda_operations.cc role); the worker checks the values
+    against the exact NumPy schedule model. (8,2) runs a 2-level
+    cross tree; (8,4) runs 4 concurrent chunk trees."""
+    _run_workers("hierarchical_adasum", size,
+                 env_extra={"HOROVOD_LOCAL_SIZE": str(local_size)})
+
+
 def test_errors_negotiated(tmp_path):
     _run_workers("errors", 2)
 
