@@ -1,0 +1,119 @@
+"""HBM roofline for the headline ResNet step.
+
+Is the measured MFU the hardware bound or a software gap? This script
+answers with numbers, not claims:
+
+* per-device ``flops`` and ``bytes accessed`` of the ACTUAL compiled
+  train step, from XLA's own cost analysis;
+* the chip's empirical bf16 matmul peak (``bench.calibrate_peak_tflops``
+  — a measured ceiling, not a datasheet number);
+* the chip's empirical HBM bandwidth: a streaming elementwise chain with
+  ``optimization_barrier`` between iterations (defeats loop fusion, so
+  every iteration really moves read+write bytes), timed by the readback
+  slope protocol;
+* the roofline bound ``t >= max(flops/peak, bytes/bw)`` vs the measured
+  step time, and the achieved/bound ratio.
+
+Prints ONE JSON line. Findings are recorded in BENCH_NOTES.md.
+"""
+
+import argparse
+import json
+import statistics
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def measure_hbm_bandwidth(nbytes=1 << 29, chain=8, repeats=3):
+    """Empirical streaming bandwidth: x <- x + 1 on an nbytes buffer,
+    ``chain`` barrier-separated iterations per call (each moves
+    2*nbytes: one read + one write), slope-timed."""
+    from horovod_tpu.utils.benchmarks import slope_window, sync
+
+    n = nbytes // 2  # bf16
+    x = jnp.zeros((n,), jnp.bfloat16)
+
+    @jax.jit
+    def stream(x):
+        for _ in range(chain):
+            x = jax.lax.optimization_barrier(x + jnp.bfloat16(1.0))
+        return x
+
+    x = stream(x)
+    sync(x)
+    samples = []
+    for _ in range(repeats):
+        dt, x = slope_window(lambda v: (stream(v),) * 2, x, iters=4,
+                             base_iters=1)
+        samples.append(4 * chain * 2 * nbytes / dt / 1e9)
+    return statistics.median(samples)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet101")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import bench
+    import horovod_tpu as hvd
+    from horovod_tpu import training
+    from horovod_tpu.utils.benchmarks import (make_model, repeat_throughput,
+                                              synthetic_batch)
+
+    hvd.init()
+    model = make_model(args.model)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    images, labels = synthetic_batch(args.batch_size * hvd.num_devices(),
+                                     args.image_size)
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        images[:1])
+    step = training.make_train_step(model, tx, donate=True)
+    cost = step.lower(state, images, labels).compile().cost_analysis()
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+
+    peak_tf, _ = bench.calibrate_peak_tflops()
+    bw_gbs = measure_hbm_bandwidth()
+
+    runs = repeat_throughput(step, state, images, labels, warmup=3,
+                             iters=args.num_iters, repeats=args.repeats)
+    step_s = statistics.median(r[1] for r in runs) / args.num_iters
+
+    # publish what WAS measurable even when a ceiling calibration fails
+    # (peak/bandwidth of 0 would otherwise divide-by-zero)
+    t_compute = flops / (peak_tf * 1e12) if peak_tf > 0 else 0.0
+    t_memory = bytes_accessed / (bw_gbs * 1e9) if bw_gbs > 0 else 0.0
+    t_bound = max(t_compute, t_memory)
+    result = {
+        "metric": f"{args.model}_roofline_achieved_over_bound",
+        "value": round(t_bound / step_s, 3) if t_bound else None,
+        "unit": "ratio",
+        "flops_per_step": flops,
+        "bytes_accessed_per_step": bytes_accessed,
+        "arithmetic_intensity_flops_per_byte": round(
+            flops / bytes_accessed, 2) if bytes_accessed else None,
+        "empirical_peak_tflops_bf16": round(peak_tf, 1),
+        "empirical_hbm_gbs": round(bw_gbs, 1),
+        "t_compute_ms": round(1e3 * t_compute, 2),
+        "t_memory_ms": round(1e3 * t_memory, 2),
+        "t_bound_ms": round(1e3 * t_bound, 2),
+        "t_measured_ms": round(1e3 * step_s, 2),
+        "bound_by": "memory" if t_memory > t_compute else "compute",
+    }
+    if peak_tf > 0:
+        result["mfu_vs_empirical_peak_pct"] = round(
+            100 * flops / step_s / (peak_tf * 1e12), 1)
+    if t_bound > 0:
+        result["mfu_bound_pct"] = round(100 * t_compute / t_bound, 1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -106,9 +106,12 @@ def main():
     sampler = data.DistributedSampler(n, num_replicas=size, rank=rank)
     for epoch in range(start, args.epochs):
         sampler.set_epoch(epoch)  # new shuffle, still disjoint per rank
+        idx = np.fromiter(iter(sampler), dtype=np.int64)
+        idx = idx[:len(idx) - len(idx) % args.batch_size]  # full batches
         losses, seen = [], 0
-        for bx, by in data.local_batches(
-                [images, labels], args.batch_size, size, rank, epoch=epoch):
+        for i in range(0, len(idx), args.batch_size):
+            b = idx[i:i + args.batch_size]
+            bx, by = images[b], labels[b]
             loss, grads, batch_stats = grad_step(
                 params, batch_stats, jnp.asarray(bx),
                 jnp.asarray(by, jnp.int32))

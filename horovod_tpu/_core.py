@@ -113,6 +113,7 @@ def init(rank=0, size=1, coord_host="127.0.0.1", coord_port=0,
 
 def shutdown():
     if _lib is not None and _lib.hvdc_is_initialized():
+        _sweep_orphans()  # drain completed fire-and-forget handles
         _lib.hvdc_shutdown()
 
 
@@ -351,6 +352,10 @@ def barrier():
     lib = _load()
     if lib.hvdc_barrier() != 0:
         raise RuntimeError("barrier failed")
+    # a barrier proves every previously enqueued op completed: sweep so
+    # fire-and-forget callers that never enqueue again don't pin
+    # orphaned buffers until process exit
+    _sweep_orphans()
 
 
 def control_bytes():

@@ -201,22 +201,30 @@ def mpi_enabled():
     return False
 
 
+_gloo_loadable = None  # caches only a positive probe (cannot un-load)
+
+
 def gloo_built():
     """Parity probe (reference ``basics.py:181``): the role Gloo plays
     in the reference (TCP collectives without MPI) is filled by the
     built-in C++ core — True when the native library is present and
     loadable. Loadability only: a capability probe must never kick off
-    the make-based build (that is ``_core.build()``'s job at init)."""
+    the make-based build (that is ``_core.build()``'s job at init).
+    A successful load is cached (repeated ``CDLL`` calls would pile up
+    dlopen references); a negative answer is re-probed, since init may
+    build the library later in the process."""
+    global _gloo_loadable
     import ctypes
     import os
 
     from horovod_tpu import _core
-    if _core._lib is not None:
+    if _core._lib is not None or _gloo_loadable:
         return True
     if not os.path.exists(_core._LIB_PATH):
         return False
     try:
         ctypes.CDLL(_core._LIB_PATH)
+        _gloo_loadable = True
         return True
     except OSError:
         return False
@@ -230,10 +238,12 @@ def nccl_built():
     (``nccl_built() >= 21000``) correctly takes its non-NCCL path here,
     while plain truthiness probes see "built".
 
-    NOTE: when horovod_tpu is not yet initialized this touches
-    ``jax.devices()``, which initializes the local JAX backend — in
-    multi-process pods call it AFTER ``hvd.init()`` (so
-    ``jax.distributed`` initializes first)."""
+    Before ``hvd.init()`` this returns 0 WITHOUT touching
+    ``jax.devices()``: a capability probe must not initialize the local
+    JAX backend out from under a pending ``jax.distributed`` setup in a
+    multi-process pod. Probe after ``init()`` for the real answer."""
+    if not is_initialized():
+        return 0
     try:
         return int(any(d.platform == "tpu" for d in jax.devices()))
     except Exception:

@@ -35,7 +35,16 @@ def _world(num_shards, shard_id):
             shard_id = basics.rank() if shard_id is None else shard_id
         else:
             num_shards = 1 if num_shards is None else num_shards
-            shard_id = 0 if shard_id is None else shard_id
+            if shard_id is None:
+                if num_shards != 1:
+                    # silently defaulting to shard 0 would hand EVERY
+                    # process the same 1/N of the data with no error
+                    raise ValueError(
+                        f"num_shards={num_shards} but no shard_id and "
+                        "horovod_tpu is not initialized; pass shard_id "
+                        "explicitly (or call hvd.init() so rank() "
+                        "supplies it)")
+                shard_id = 0
     if not 0 <= shard_id < num_shards:
         raise ValueError(f"shard_id {shard_id} not in [0, {num_shards})")
     return num_shards, shard_id

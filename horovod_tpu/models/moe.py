@@ -54,6 +54,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+_GROUP_FALLBACKS = set()  # (T, num_groups) pairs already logged
+
 
 class MoE(nn.Module):
     """Top-k MoE FFN: ``[T, d_model] -> [T, d_model]``.
@@ -106,6 +108,20 @@ class MoE(nn.Module):
         G = max(1, min(self.num_groups, T))
         while T % G != 0:
             G -= 1
+        if G != self.num_groups:
+            # effective G changes per-group capacity and therefore which
+            # tokens get dropped — the same config routes differently at
+            # a different batch*seq. One info line per (T, num_groups)
+            # so the numerics shift is never silent.
+            key = (T, self.num_groups)
+            if key not in _GROUP_FALLBACKS:
+                _GROUP_FALLBACKS.add(key)
+                import logging
+                logging.getLogger("horovod_tpu").info(
+                    "MoE grouped dispatch: T=%d not divisible by "
+                    "num_groups=%d; using G=%d (affects per-group "
+                    "capacity and routing/drop numerics)",
+                    T, self.num_groups, G)
         if T > 1024 and 2 * G <= self.num_groups:
             # the divisor fallback quietly reinstated (most of) the
             # O(T^2) dispatch wall — surface it: at real token counts an

@@ -39,9 +39,9 @@ class TransformerConfig:
     # (ops/flash_attention.py). Requires the default contiguous positions;
     # falls back to plain XLA attention when shapes don't tile.
     # None (default) = auto: ON when running on TPU with local seq >=
-    # 4096 — the measured crossover on v5e (BENCH_NOTES.md: at seq 2048
-    # XLA's fused dense attention is ~15% faster end-to-end; at 4096
-    # flash wins and dense memory explodes O(S^2)). OFF elsewhere
+    # 1024 — the measured crossover on v5e with bf16 operands and
+    # 512x512 blocks (BENCH_NOTES.md round 5: flash fwd+bwd is ~2.4x
+    # dense at seq 2048, ~4x at 1024; a wash at 512). OFF elsewhere
     # (interpret mode would crawl). Set True/False to force.
     flash_attention: Optional[bool] = None
     # Sparse-FFN blocks: every `moe_every`-th block (1-based; 0 = dense
@@ -106,7 +106,7 @@ class Attention(nn.Module):
             # auto: TPU only, and only past the measured seq crossover
             # (see TransformerConfig.flash_attention)
             use_flash = (jax.devices()[0].platform == "tpu"
-                         and x.shape[1] >= 4096)
+                         and x.shape[1] >= 1024)
         if cfg.sequence_axis is not None:
             from horovod_tpu.parallel import ring
             if use_flash and contiguous_positions:

@@ -1,0 +1,57 @@
+"""Launcher pre-flight cache (reference: ``run/util/cache.py``).
+
+Multi-host launches re-probe NIC reachability on every invocation even
+though the answer only changes when the cluster does. The reference
+caches initialization-check results for 60 minutes under ``~/.horovod``
+(``--disable-cache`` skips it); this is the same contract with JSON
+instead of cloudpickle (the cached values are plain strings/lists — no
+reason to deserialize executable pickles from a shared home directory).
+"""
+
+import json
+import os
+import tempfile
+import time
+
+DEFAULT_DIR = os.path.expanduser("~/.horovod_tpu")
+DEFAULT_TTL = 60 * 60  # the reference's 60-minute staleness threshold
+
+
+class Cache:
+    """A tiny persistent {key: (timestamp, value)} store.
+
+    Corrupt or unreadable cache files are treated as empty (a cache must
+    never be able to fail a launch)."""
+
+    def __init__(self, folder=DEFAULT_DIR, ttl=DEFAULT_TTL):
+        self._path = os.path.join(folder, "cache.json")
+        self._ttl = ttl
+
+    def _load(self):
+        try:
+            with open(self._path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def get(self, key):
+        """The cached value for ``key``, or None when absent/expired."""
+        entry = self._load().get(key)
+        if not entry:
+            return None
+        ts, value = entry
+        if time.time() - ts > self._ttl:
+            return None
+        return value
+
+    def put(self, key, value):
+        data = self._load()
+        data[key] = (time.time(), value)
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self._path))
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._path)  # atomic, like checkpoint writes
+        except OSError:
+            pass  # caching is best-effort

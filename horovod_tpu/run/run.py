@@ -11,6 +11,7 @@ import socket
 import sys
 
 from horovod_tpu.run import allocation, config_parser, launcher
+from horovod_tpu.run import cache as run_cache
 from horovod_tpu.run import secret as _secret
 from horovod_tpu.run.discovery import DriverService
 from horovod_tpu.run.rendezvous import KVStoreServer
@@ -99,8 +100,13 @@ def build_parser():
     tune.add_argument("--cycle-time-ms", type=float, default=None)
     tune.add_argument("--cache-capacity", type=int, default=None)
     tune.add_argument("--disable-cache", action="store_true",
-                      help="turn the response cache off "
-                           "(HOROVOD_CACHE_CAPACITY=0)")
+                      help="disable caching: the launcher's pre-flight "
+                           "NIC-discovery cache (reference "
+                           "--disable-cache semantics; forces a fresh "
+                           "probe) AND the runtime response cache "
+                           "(HOROVOD_CACHE_CAPACITY=0). To only refresh "
+                           "the pre-flight cache, delete "
+                           "~/.horovod_tpu/cache.json")
     tune.add_argument("--hierarchical-allreduce", action="store_true")
     tune.add_argument("--hierarchical-allgather", action="store_true")
     tune.add_argument("--autotune", action="store_true")
@@ -228,8 +234,21 @@ def _run(args):
     if args.nic:
         extra_env["HOROVOD_COMMON_INTERFACES"] = args.nic
     elif not all_local and not args.no_interface_discovery:
-        common = _discover_interfaces(hosts, auth_key, rendezvous_port,
-                                      args, extra_env)
+        # same host set within the TTL -> same routable NICs: serve the
+        # pre-flight from the launcher cache (reference run/util/cache.py
+        # behavior; --disable-cache forces a fresh probe)
+        cache_key = "nics:" + ",".join(
+            sorted({h.hostname for h in hosts}))
+        nic_cache = run_cache.Cache()
+        common = (None if getattr(args, "disable_cache", False)
+                  else nic_cache.get(cache_key))
+        if common is None:
+            common = _discover_interfaces(hosts, auth_key, rendezvous_port,
+                                          args, extra_env)
+            nic_cache.put(cache_key, sorted(common))
+        elif args.verbose:
+            print(f"hvdrun: cached routable interfaces: {common}",
+                  file=sys.stderr)
         if common:
             extra_env["HOROVOD_COMMON_INTERFACES"] = ",".join(common)
     if args.jax_coordinator:

@@ -105,6 +105,12 @@ def test_local_batches_disjoint_across_ranks():
 
 
 def test_world_defaults_without_init():
+    import horovod_tpu as hvd
+    hvd.shutdown()  # another module's test may have left hvd live
     # uninitialized horovod -> world of 1, shard 0 (identity sharding)
     idx = data.shard_indices(6, shuffle=False)
     np.testing.assert_array_equal(idx, np.arange(6))
+    # but num_shards > 1 with no shard_id must NOT default to shard 0
+    # (every process would silently train on the same slice)
+    with pytest.raises(ValueError, match="shard_id"):
+        data.shard_indices(8, num_shards=4)

@@ -33,6 +33,52 @@ def test_forward_matches_oracle(causal):
                                atol=2e-5)
 
 
+def test_block_fit_non_pow2_sequences():
+    """Sequences that are multiples of 128 but not of the 512 default
+    block (1280, 1152) must still run the kernel: the block fits DOWN to
+    the largest divisor instead of rejecting the shape."""
+    assert fa._fit_block(1280, 512) == 256
+    assert fa._fit_block(1152, 512) == 128
+    assert fa._fit_block(2048, 512) == 512
+    assert fa._fit_block(48, 512) == 48
+    assert fa._fit_block(12, 512) == 0  # not a multiple of 8
+    assert fa.kernel_supported(1280, 1280, 64)
+    q, k, v = _qkv(np.random.default_rng(3), s=160)  # 160 = 32*5
+    out = fa.flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_oracle(q, k, v)), atol=2e-5)
+
+
+def test_bf16_forward_and_grads_match_f32_oracle():
+    """bf16 inputs run the MXU-native path (matmul operands stay bf16,
+    accumulation/softmax fp32) — values must track the f32 oracle within
+    bf16 tolerance. Pins the perf-critical no-upcast behavior: fp32
+    operands would run the MXU at a fraction of peak."""
+    rng = np.random.default_rng(7)
+    q32, k32, v32 = _qkv(rng, s=128)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q32, k32, v32))
+    out = fa.flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(_oracle(q32, k32, v32)),
+        atol=5e-2)
+
+    def f(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v).astype(jnp.float32) ** 2)
+
+    def f32(q, k, v):
+        return jnp.sum(_oracle(q, k, v) ** 2)
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g32 = jax.grad(f32, argnums=(0, 1, 2))(q32, k32, v32)
+    for a, b in zip(g, g32):
+        assert a.dtype == jnp.bfloat16
+        scale = np.maximum(np.abs(np.asarray(b)), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32) / scale, np.asarray(b) / scale,
+            atol=8e-2)
+
+
 def test_gradients_match_oracle():
     q, k, v = _qkv(np.random.default_rng(1), s=128)
 

@@ -194,6 +194,93 @@ def test_cli_end_to_end(tmp_path):
     assert rv.returncode == 0, rv.stdout + rv.stderr
 
 
+def test_preflight_cache_roundtrip_ttl_and_corruption(tmp_path):
+    """Launcher pre-flight cache (reference run/util/cache.py): NIC
+    discovery results persist for the TTL, expire after it, and a
+    corrupt cache file can never fail a launch."""
+    from horovod_tpu.run import cache as run_cache
+    c = run_cache.Cache(folder=str(tmp_path), ttl=3600)
+    assert c.get("nics:a,b") is None
+    c.put("nics:a,b", ["eth0", "ib0"])
+    assert c.get("nics:a,b") == ["eth0", "ib0"]
+    # expired entries are misses
+    expired = run_cache.Cache(folder=str(tmp_path), ttl=0)
+    assert expired.get("nics:a,b") is None
+    # corruption tolerance
+    with open(str(tmp_path / "cache.json"), "w") as f:
+        f.write("{not json")
+    assert c.get("nics:a,b") is None
+    c.put("nics:a,b", ["eth0"])  # rewrites over the corrupt file
+    assert c.get("nics:a,b") == ["eth0"]
+
+
+def test_worker_killed_mid_step_fans_out(tmp_path):
+    """Failure injection (reference test_interactiverun.py:62 pattern):
+    rank 1 dies by SIGKILL mid-job while rank 0 blocks in a collective
+    that can now never complete. The launcher's monitor must fan the
+    kill out to rank 0 and propagate a nonzero exit — WITHOUT waiting
+    for rank 0's 120 s sleep."""
+    script = tmp_path / "die.py"
+    script.write_text(textwrap.dedent("""
+        import os, signal, time
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        hvd.allreduce(np.ones(2, np.float32))  # both ranks healthy
+        if hvd.rank() == 1:
+            os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, no exit code
+        hvd.allreduce(np.ones(2, np.float32))  # rank 0 blocks here
+        time.sleep(120)
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rv = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=90)
+    assert rv.returncode == 1
+    # SIGKILL death surfaces as 128+9 through the safe_exec middleman
+    assert "exited with code 137" in rv.stderr
+    assert "remaining processes were terminated" in rv.stderr
+
+
+def test_stalled_rank_named_before_death(tmp_path):
+    """A rank that stops participating (but stays alive) must be NAMED
+    by the stall inspector on the coordinator's stderr (reference
+    stall_inspector.cc: 'missing ranks' warning) before the job dies;
+    the laggard's eventual failure still fans out and propagates."""
+    script = tmp_path / "stall.py"
+    script.write_text(textwrap.dedent("""
+        import sys, time
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        hvd.allreduce(np.ones(2, np.float32))
+        if hvd.rank() == 1:
+            time.sleep(8)   # stops participating; stall warn fires at ~1s
+            sys.exit(5)
+        hvd.allreduce(np.ones(2, np.float32))  # rank 0 waits on rank 1
+        time.sleep(120)
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
+    rv = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=90)
+    assert rv.returncode == 1
+    assert "missing ranks: 1" in (rv.stderr + rv.stdout)
+    # either failure may win the monitor race: rank 1's exit(5), or
+    # rank 0's RuntimeError (exit 1) when rank 1's shutdown breaks the
+    # pending collective — both propagate and terminate the job
+    assert ("exited with code 5" in rv.stderr
+            or "exited with code 1" in rv.stderr)
+    assert "remaining processes were terminated" in rv.stderr
+
+
 def test_cli_failure_kills_job(tmp_path):
     script = tmp_path / "crash.py"
     script.write_text(textwrap.dedent("""
