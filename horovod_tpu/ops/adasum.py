@@ -58,10 +58,15 @@ def adasum_allreduce(x, axes):
         axes = (axes,)
     if len(axes) > 1:
         # Hierarchical variant (adasum_cuda_operations.cc): sum-scatter
-        # over the inner (ICI) axes, per-chunk Adasum across the outer
-        # (DCN) axis, all-gather, divide by the inner size.
-        return hierarchical_adasum_allreduce(x, ici_axes=tuple(axes[1:]),
-                                             dcn_axis=axes[0])
+        # over the inner (ICI) axes, per-chunk Adasum across the cross-
+        # slice axis, all-gather, divide by the inner size. The cross
+        # axis is found BY NAME when the mesh has one (axis order must
+        # not change which axis crosses slices); otherwise the first
+        # axis plays that role.
+        from horovod_tpu.parallel.mesh import DCN_AXIS
+        dcn = DCN_AXIS if DCN_AXIS in axes else axes[0]
+        return hierarchical_adasum_allreduce(
+            x, ici_axes=tuple(a for a in axes if a != dcn), dcn_axis=dcn)
     axis = axes[0]
     size = lax.axis_size(axis)
     if size & (size - 1):

@@ -120,7 +120,12 @@ def fused_allreduce(tree, op=collective.Average, axes=None,
         flat = _pack(bucket, leaves)
         if compression is not None:
             flat, ctx = compression.compress(flat)
-        if hierarchical and DCN_AXIS in axes and len(axes) > 1:
+        # the RS->AR->AG hierarchy only exists for sum/average; every
+        # other op falls through to collective.allreduce, which computes
+        # Min/Max flat and already runs Adasum's OWN 2-level composite
+        # on a multi-axis mesh (ops/adasum.py) — one dispatch copy
+        if (hierarchical and op in (collective.Sum, collective.Average)
+                and DCN_AXIS in axes and len(axes) > 1):
             ici_axes = tuple(a for a in axes if a != DCN_AXIS)
             flat = hier_lib.hierarchical_allreduce(
                 flat, ici_axes=ici_axes, dcn_axis=DCN_AXIS, op=op)

@@ -27,6 +27,11 @@ def hierarchical_allreduce(x, ici_axes=(DATA_AXIS,), dcn_axis=DCN_AXIS,
        same as the reference's per-local-rank parallel MPI_Allreduce),
     3. all-gather over the ICI axes.
     """
+    if op not in ("sum", "average"):
+        # Adasum has its own composite (ops.adasum.
+        # hierarchical_adasum_allreduce); min/max don't reduce-scatter
+        raise ValueError(
+            f"hierarchical_allreduce supports sum/average, got {op!r}")
     if isinstance(ici_axes, str):
         ici_axes = (ici_axes,)
     shape = x.shape

@@ -1,0 +1,72 @@
+"""Unit tests for the shared timing scaffold (utils/benchmarks.py) —
+the measurement discipline every bench path rides (BENCH_NOTES.md)."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.utils import benchmarks
+
+
+def test_window_time_is_a_float_with_flag():
+    t = benchmarks.WindowTime(1.5)
+    assert t == 1.5 and t + 0.5 == 2.0
+    assert t.upper_bound is False
+    b = benchmarks.WindowTime(2.0, upper_bound=True)
+    assert b.upper_bound is True
+    assert isinstance(b * 2, float)
+
+
+def test_sync_forces_scalar_readback():
+    out = benchmarks.sync({"a": jnp.arange(4.0)})
+    assert isinstance(out, float) and out == 0.0
+
+
+def test_slope_window_measures_per_iteration_cost():
+    """A step with a known sleep: the slope (difference of windows)
+    must recover the per-iteration cost, cancelling fixed overhead."""
+    def step(state):
+        time.sleep(0.01)
+        return state + 1, jnp.asarray(float(state))
+
+    dt, state = benchmarks.slope_window(step, 0, iters=5, base_iters=1)
+    assert isinstance(dt, benchmarks.WindowTime)
+    assert not dt.upper_bound
+    assert 0.03 < dt < 0.3  # ~5 * 10 ms, generous bounds for CI noise
+    # state threads through every call: one attempt = 7 calls, a single
+    # jitter-inversion retry = 14 (retry is legal, a THIRD is not)
+    assert state in (7, 14)
+
+
+def test_slope_window_inverted_marks_upper_bound():
+    """When the 'work' is pure jitter (longer window measured FASTER),
+    the fallback reports the full window and FLAGS it — bound samples
+    must be distinguishable from measurements (ADVICE r4)."""
+    calls = {"n": 0}
+
+    def step(state):
+        calls["n"] += 1
+        # calls 1 and 5 are the two BASE windows (attempt + retry):
+        # making only those slow guarantees both inversions
+        time.sleep(0.03 if calls["n"] in (1, 5) else 0.0)
+        return state, jnp.asarray(0.0)
+
+    with pytest.warns(UserWarning, match="inverted twice"):
+        dt, _ = benchmarks.slope_window(step, 0, iters=2, base_iters=1)
+    assert dt.upper_bound is True
+    assert dt > 0
+
+
+def test_repeat_throughput_propagates_window_times():
+    def step(state, images, labels):
+        return state, jnp.asarray(0.0)
+
+    imgs = np.zeros((4, 1))
+    runs = benchmarks.repeat_throughput(step, 0, imgs, None, warmup=0,
+                                        iters=3, repeats=2)
+    assert len(runs) == 2
+    for rate, dt in runs:
+        assert isinstance(dt, benchmarks.WindowTime)
+        assert rate > 0

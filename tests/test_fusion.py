@@ -113,6 +113,45 @@ def test_fused_allreduce_hierarchical_on_2d_mesh(hvd2d, n_devices):
     np.testing.assert_allclose(out["w"], expected * np.ones((9,)), rtol=1e-6)
 
 
+def test_fused_allreduce_hierarchical_adasum(hvd2d, n_devices, rng):
+    """DistributedOptimizer(op=Adasum, hierarchical=True) semantics: the
+    fused hierarchical branch must run the 2-level Adasum COMPOSITE
+    (per-chunk Adasum across dcn), never a cross-slice psum."""
+    from horovod_tpu.ops import adasum
+    data_size = n_devices // 2
+    vals = rng.standard_normal((n_devices, 10)).astype(np.float32)
+    expected = adasum.hierarchical_adasum_np(
+        vals.reshape(2, data_size, 10))
+
+    def f():
+        tree = {"g": jnp.asarray(vals)[
+            collective.mesh_rank(("dcn", "data"))]}
+        return fusion.fused_allreduce(tree, op=hvd_api.Adasum,
+                                      axes=("dcn", "data"),
+                                      hierarchical=True)
+
+    out = jax.shard_map(f, mesh=hvd2d.mesh(), in_specs=(),
+                        out_specs={"g": P()}, check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out["g"]), expected,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_allreduce_hierarchical_min_falls_through(hvd2d, n_devices):
+    """Min/Max have no RS->AR->AG form: with hierarchical=True they must
+    fall through to the flat path and stay CORRECT (not raise, not
+    silently sum)."""
+    def f():
+        r = collective.mesh_rank(("dcn", "data")).astype(jnp.float32)
+        return fusion.fused_allreduce({"x": r + jnp.zeros((3,))},
+                                      op=hvd_api.Min,
+                                      axes=("dcn", "data"),
+                                      hierarchical=True)
+
+    out = jax.shard_map(f, mesh=hvd2d.mesh(), in_specs=(),
+                        out_specs={"x": P()}, check_vma=False)()
+    np.testing.assert_allclose(out["x"], np.zeros((3,)))
+
+
 def test_fused_allreduce_empty_tree(hvd):
     assert fusion.fused_allreduce({}) == {}
 
