@@ -71,6 +71,7 @@ from horovod_tpu.hvd_jax import (
 )
 from horovod_tpu import checkpoint
 from horovod_tpu import data
+from horovod_tpu import elastic
 
 __version__ = "0.1.0"
 
@@ -88,5 +89,5 @@ __all__ = [
     "distributed_grad", "distributed_value_and_grad",
     "broadcast_variables", "broadcast_parameters",
     "broadcast_optimizer_state", "allreduce_metrics", "join",
-    "checkpoint", "data",
+    "checkpoint", "data", "elastic",
 ]

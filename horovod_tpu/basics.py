@@ -230,6 +230,9 @@ def gloo_built():
         return False
 
 
+_nccl_preinit_warned = False  # warn once per process, not per probe
+
+
 def nccl_built():
     """Parity probe (reference ``basics.py:189``): the "NCCL of TPU" is
     the XLA/ICI collective path. Returns an int like the reference
@@ -243,6 +246,13 @@ def nccl_built():
     JAX backend out from under a pending ``jax.distributed`` setup in a
     multi-process pod. Probe after ``init()`` for the real answer."""
     if not is_initialized():
+        global _nccl_preinit_warned
+        if not _nccl_preinit_warned:
+            _nccl_preinit_warned = True
+            logger.warning(
+                "nccl_built() probed before hvd.init(): the TPU backend "
+                "is not attached yet, so this reports 0 (not built). "
+                "Probe again after init() for the real answer.")
         return 0
     try:
         return int(any(d.platform == "tpu" for d in jax.devices()))

@@ -36,13 +36,23 @@ def save_checkpoint(directory, step, params, opt_state=None, meta=None,
     ``meta`` is a small JSON-able dict (e.g. epoch, rng seed). ``keep``
     (int) prunes all but the newest N checkpoints after a successful
     write."""
+    import horovod_tpu as hvd
+    if hvd.rank() != 0:
+        return None
+    return write_checkpoint(directory, step, params, opt_state=opt_state,
+                            meta=meta, keep=keep)
+
+
+def write_checkpoint(directory, step, params, opt_state=None, meta=None,
+                     keep=None):
+    """Rank-agnostic checkpoint write (atomic tmp+rename). Callers that
+    are not under an initialized ``hvd`` — the elastic ``JaxState``, whose
+    commits may run before/without ``init()`` — gate on their own notion
+    of rank; everyone else should use :func:`save_checkpoint`."""
     import json
 
     from flax import serialization
 
-    import horovod_tpu as hvd
-    if hvd.rank() != 0:
-        return None
     os.makedirs(directory, exist_ok=True)
     # meta rides as one JSON string leaf: flax from_bytes restores by the
     # TARGET's structure, so a dict-of-unknown-keys would come back empty

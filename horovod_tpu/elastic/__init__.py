@@ -1,0 +1,68 @@
+"""horovod_tpu.elastic — fault-tolerant, membership-elastic training.
+
+The post-0.20 ``horovod.elastic`` capability rebuilt on this framework's
+primitives (see docs/ELASTIC.md):
+
+* **discovery** — ``HostDiscovery`` / ``FixedHosts`` / ``ScriptDiscovery``
+  + a polling thread diffing host sets,
+* **state** — ``State`` / ``ObjectState`` / ``JaxState`` with
+  ``commit()`` / ``restore()`` / ``sync()`` (collective broadcast from
+  the lowest committed rank; optional disk-backed commits via
+  ``checkpoint.py``),
+* **driver** — ``ElasticDriver`` + ``Blacklist``: per-epoch rendezvous
+  over ``run/allocation.py``, failure blame with exponential backoff,
+* **notification** — the driver-to-worker interrupt plane (HMAC-framed
+  TCP, same wire format as ``run/discovery.py``),
+* **runner** — the ``@hvd.elastic.run`` retry loop.
+
+Typical worker::
+
+    import horovod_tpu as hvd
+
+    state = hvd.elastic.JaxState(directory=ckpt_dir,
+                                 train_state=ts)
+
+    @hvd.elastic.run
+    def train(state):
+        while int(state.train_state.step) < num_steps:
+            state.train_state, loss = step(state.train_state, *batch())
+            state.commit()
+
+    train(state)
+"""
+
+from horovod_tpu.elastic.discovery import (FixedHosts, HostDiscovery,
+                                           HostDiscoveryPoller,
+                                           HostUpdateResult,
+                                           ScriptDiscovery, diff_hosts)
+from horovod_tpu.elastic.driver import (EXIT_RENDEZVOUS, Blacklist,
+                                        ElasticDriver)
+from horovod_tpu.elastic.exceptions import (HorovodInternalError,
+                                            HostsUpdatedInterrupt,
+                                            WorkerFailureError)
+from horovod_tpu.elastic.notification import (WorkerNotificationClient,
+                                              WorkerNotificationManager,
+                                              WorkerNotificationService,
+                                              notification_manager)
+from horovod_tpu.elastic.runner import run
+from horovod_tpu.elastic.state import JaxState, ObjectState, State
+from horovod_tpu.elastic.worker import (WorkerContext,
+                                        attach_progress_reporter,
+                                        get_worker_context,
+                                        init_worker_context,
+                                        is_elastic_worker,
+                                        shutdown_worker_context)
+
+__all__ = [
+    "HostDiscovery", "FixedHosts", "ScriptDiscovery",
+    "HostDiscoveryPoller", "HostUpdateResult", "diff_hosts",
+    "State", "ObjectState", "JaxState",
+    "HostsUpdatedInterrupt", "WorkerFailureError", "HorovodInternalError",
+    "ElasticDriver", "Blacklist", "EXIT_RENDEZVOUS",
+    "WorkerNotificationManager", "WorkerNotificationService",
+    "WorkerNotificationClient", "notification_manager",
+    "WorkerContext", "init_worker_context", "get_worker_context",
+    "shutdown_worker_context", "attach_progress_reporter",
+    "is_elastic_worker",
+    "run",
+]

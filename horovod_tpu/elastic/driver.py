@@ -1,0 +1,397 @@
+"""Elastic driver: membership tracking, blacklisting, re-rendezvous.
+
+Reference: ``horovod/run/elastic/driver.py`` — the launcher-side brain of
+an elastic job. It owns
+
+* a :class:`~horovod_tpu.elastic.discovery.HostDiscoveryPoller` whose
+  diffs become worker interrupts (notification.py) + timeline
+  ``MEMBERSHIP`` markers,
+* a :class:`Blacklist` of repeatedly-failing hosts (exponential backoff,
+  then permanent exclusion — reference ``blacklist_host`` semantics),
+* the rendezvous loop: each *epoch* assigns ranks to the current
+  non-excluded host set (reusing ``run/allocation.py``), publishes the
+  assignment on the launcher KV, launches workers, and decides from exit
+  codes whether the job is done, needs a plain re-rendezvous (graceful
+  ``EXIT_RENDEZVOUS``), or a failure round (blame + retry).
+
+Recovery model (docs/ELASTIC.md): workers are re-*launched* per epoch —
+the state plane (elastic/state.py commit/restore/sync) provides
+continuity, the driver provides membership. Worker liveness feeds in
+through the KV heartbeats published by ``runtime/stall.py`` progress
+hooks (elastic/worker.py).
+"""
+
+import json
+import logging
+import sys
+import threading
+import time
+
+from horovod_tpu.elastic.discovery import HostDiscoveryPoller
+from horovod_tpu.elastic.notification import WorkerNotificationClient
+from horovod_tpu.run import allocation
+
+logger = logging.getLogger("horovod_tpu")
+
+# Worker exit code meaning "re-rendezvous requested" (EX_TEMPFAIL): the
+# elastic loop exits with it on HostsUpdatedInterrupt under a driver, so
+# the driver can tell a graceful world change from a crash.
+EXIT_RENDEZVOUS = 75
+
+
+class Blacklist:
+    """Failure accounting per host (reference ``ElasticDriver``'s
+    blacklist + cooldown): each failure excludes the host for an
+    exponentially growing backoff window; after ``threshold`` failures it
+    is excluded permanently.
+
+    ``clock`` is injectable so tests can drive the backoff without
+    sleeping."""
+
+    def __init__(self, threshold=3, base_delay=5.0, max_delay=600.0,
+                 clock=time.monotonic):
+        self._threshold = threshold
+        self._base = base_delay
+        self._max = max_delay
+        self._clock = clock
+        self._failures = {}   # host -> count
+        self._cooldown = {}   # host -> excluded-until timestamp
+
+    def record_failure(self, host):
+        n = self._failures.get(host, 0) + 1
+        self._failures[host] = n
+        delay = min(self._base * (2 ** (n - 1)), self._max)
+        self._cooldown[host] = self._clock() + delay
+        if n >= self._threshold:
+            logger.warning("elastic: host %s blacklisted after %d failures",
+                           host, n)
+        else:
+            logger.warning("elastic: host %s failed (%d/%d), backing off "
+                           "%.1fs", host, n, self._threshold, delay)
+        return n
+
+    def count(self, host):
+        return self._failures.get(host, 0)
+
+    def blacklisted(self, host):
+        """Permanently excluded (failure count reached the threshold)."""
+        return self._failures.get(host, 0) >= self._threshold
+
+    def excluded(self, host, now=None):
+        """Excluded right now: blacklisted, or inside a backoff window."""
+        if self.blacklisted(host):
+            return True
+        until = self._cooldown.get(host)
+        if until is None:
+            return False
+        return (now if now is not None else self._clock()) < until
+
+    @property
+    def hosts(self):
+        """The permanently blacklisted host set."""
+        return {h for h, n in self._failures.items()
+                if n >= self._threshold}
+
+
+class ElasticDriver:
+    """Launcher-side elastic controller.
+
+    ``kv`` is the launcher's :class:`~horovod_tpu.run.rendezvous.
+    KVStoreServer`; workers publish their notification endpoints and
+    heartbeats there (elastic/worker.py) and the driver publishes each
+    epoch's rank assignment under ``elastic/slots/<epoch>``.
+    """
+
+    def __init__(self, discovery, min_np, max_np=None, blacklist=None,
+                 kv=None, auth_key=None, poll_interval=1.0, timeline=None,
+                 start_timeout=600, hopeless_grace=30.0):
+        if min_np < 1:
+            raise ValueError(f"min_np must be >= 1 (got {min_np})")
+        if max_np is not None and max_np < min_np:
+            raise ValueError(
+                f"max_np ({max_np}) must be >= min_np ({min_np})")
+        self.min_np = min_np
+        self.max_np = max_np
+        self.blacklist = blacklist if blacklist is not None else Blacklist()
+        self._kv = kv
+        self._auth_key = auth_key
+        self._timeline = timeline
+        self._start_timeout = start_timeout
+        self._hopeless_grace = hopeless_grace
+        self._poll_interval = poll_interval
+        self.epoch = 0
+        self._current_slots = []
+        self._membership_dirty = False
+        self._poller = HostDiscoveryPoller(
+            discovery, poll_interval=poll_interval,
+            on_update=self._on_hosts_updated)
+
+    # -- membership ----------------------------------------------------------
+    def available_hosts(self):
+        """Current discovery view minus excluded hosts."""
+        hosts = self._poller.current()
+        return {h: s for h, s in hosts.items()
+                if s > 0 and not self.blacklist.excluded(h)}
+
+    def available_slots(self):
+        return sum(self.available_hosts().values())
+
+    def wait_for_available_slots(self, count, timeout=None):
+        """Block until at least ``count`` slots exist on non-excluded
+        hosts (reference ``wait_for_available_slots``); TimeoutError
+        names the shortfall and the blacklist."""
+        timeout = timeout if timeout is not None else self._start_timeout
+        deadline = time.monotonic() + timeout
+        hopeless_deadline = None
+        while True:
+            hosts = self.available_hosts()
+            if sum(hosts.values()) >= count:
+                return hosts
+            # hosts in a backoff window come back on their own, but
+            # permanently blacklisted ones never do: when even counting
+            # the cooled-down hosts the target is unreachable, only NEW
+            # hosts from discovery could save the job — fail fast after
+            # a short grace instead of burning the full start timeout.
+            # The clamp is recomputed per iteration so a transiently
+            # empty discovery view (flaky script) cannot permanently
+            # shorten the real deadline.
+            view = self._poller.current()
+            potential = sum(s for h, s in view.items()
+                            if not self.blacklist.blacklisted(h))
+            if potential < count:
+                if hopeless_deadline is None:
+                    hopeless_deadline = time.monotonic() + min(
+                        timeout, self._hopeless_grace)
+                effective = min(deadline, hopeless_deadline)
+            else:
+                hopeless_deadline = None
+                effective = deadline
+            if time.monotonic() >= effective:
+                raise TimeoutError(
+                    f"elastic: needed {count} slots but only "
+                    f"{sum(hosts.values())} available after {timeout:.0f}s "
+                    f"(hosts={sorted(hosts)}, "
+                    f"blacklisted={sorted(self.blacklist.hosts)})")
+            # re-poll at the configured discovery cadence (the wait must
+            # not hammer an external discovery script at 4 Hz)
+            time.sleep(min(self._poll_interval,
+                           max(0.05, effective - time.monotonic())))
+            self._poller.poll_once()
+
+    def _on_hosts_updated(self, added, removed, current, res):
+        logger.info("elastic: host set changed (added=%s removed=%s)",
+                    added, removed)
+        self._membership_event("UPDATED",
+                               {"added": added, "removed": removed,
+                                "hosts": sorted(current)})
+        reason = "removed" if removed and not added else (
+            "added" if added and not removed else "updated")
+        self._membership_dirty = True
+        if not self.notify_workers(reason):
+            # workers may still be booting (endpoint not yet on the KV):
+            # keep trying in the background until one acks or the epoch
+            # turns over — a membership change must never be lost to a
+            # startup race
+            self._notify_until_acked(reason, self.epoch)
+
+    def _notify_until_acked(self, res, epoch, attempts=120, interval=0.25):
+        def _retry():
+            for _ in range(attempts):
+                time.sleep(interval)
+                if self.epoch != epoch:
+                    return
+                if self.notify_workers(res):
+                    return
+            logger.warning("elastic: no worker acked the %s membership "
+                           "update in epoch %d", res, epoch)
+
+        threading.Thread(target=_retry, daemon=True,
+                         name="hvd_tpu_elastic_notify").start()
+
+    def _membership_event(self, event, details):
+        if self._timeline is not None:
+            self._timeline.membership(event, details)
+
+    # -- worker notification / liveness --------------------------------------
+    def _worker_endpoints(self):
+        """Notification endpoints the current epoch's workers published
+        on the KV (rank -> (addr, port))."""
+        if self._kv is None:
+            return {}
+        endpoints = {}
+        for slot in self._current_slots:
+            raw = self._kv.get(f"elastic/notif/{self.epoch}/{slot.rank}")
+            if raw is None:
+                continue
+            info = json.loads(raw)
+            endpoints[slot.rank] = (info["addr"], int(info["port"]))
+        return endpoints
+
+    def notify_workers(self, res="updated"):
+        """Post a hosts-updated interrupt to every reachable worker;
+        unreachable ones are already dead or will learn at relaunch."""
+        notified = []
+        for rank, (addr, port) in self._worker_endpoints().items():
+            try:
+                acked = WorkerNotificationClient(
+                    addr, port, key=self._auth_key).notify_hosts_updated(res)
+            except OSError:
+                continue
+            if acked:  # a dropped frame / empty reply is NOT delivery
+                notified.append(rank)
+        return notified
+
+    def worker_progress(self):
+        """The driver's liveness view: last heartbeat each current worker
+        published through its stall-inspector progress hook
+        (``elastic/heartbeat/<epoch>/<rank>`` -> {step, time})."""
+        if self._kv is None:
+            return {}
+        progress = {}
+        for slot in self._current_slots:
+            raw = self._kv.get(f"elastic/heartbeat/{self.epoch}/{slot.rank}")
+            if raw is not None:
+                progress[slot.rank] = json.loads(raw)
+        return progress
+
+    # -- rendezvous ----------------------------------------------------------
+    def rendezvous(self):
+        """Open a new epoch: wait for min-np capacity, assign ranks to
+        the current host set (capped at max-np), publish the assignment.
+        Returns the slot list."""
+        hosts = self.wait_for_available_slots(self.min_np)
+        host_list = [allocation.HostSlots(h, s)
+                     for h, s in sorted(hosts.items())]
+        total = sum(h.slots for h in host_list)
+        np_now = min(total, self.max_np) if self.max_np else total
+        self.epoch += 1
+        slots = allocation.allocate(host_list, np_now)
+        self._current_slots = slots
+        if self._kv is not None:
+            # stale cross-epoch coordination keys must not leak into the
+            # new world (a late rank would adopt epoch N-1's controller)
+            self._kv.delete("controller/port")
+            self._kv.put(f"elastic/slots/{self.epoch}", json.dumps(
+                [{"rank": s.rank, "host": s.hostname,
+                  "local_rank": s.local_rank} for s in slots]).encode())
+            self._kv.put("elastic/epoch", str(self.epoch).encode())
+        self._membership_event("RENDEZVOUS",
+                               {"epoch": self.epoch, "np": np_now,
+                                "hosts": sorted(hosts)})
+        logger.info("elastic: epoch %d rendezvous: %d ranks on %s",
+                    self.epoch, np_now, sorted(hosts))
+        return slots
+
+    def worker_env(self):
+        """Extra env vars every elastic worker gets (the elastic side of
+        the launcher env contract)."""
+        env = {"HOROVOD_ELASTIC": "1",
+               "HOROVOD_ELASTIC_EPOCH": str(self.epoch),
+               "HOROVOD_ELASTIC_MIN_NP": str(self.min_np)}
+        if self.max_np is not None:
+            env["HOROVOD_ELASTIC_MAX_NP"] = str(self.max_np)
+        return env
+
+    # -- the retry loop ------------------------------------------------------
+    def run_job(self, launch_fn, max_epochs=None):
+        """Drive the job to completion: launch an epoch, inspect exit
+        codes, blame/blacklist, re-rendezvous, repeat.
+
+        ``launch_fn(slots, epoch, extra_env)`` must start one worker per
+        slot and return a :class:`horovod_tpu.run.launcher.Job` (or
+        anything with ``join() -> {rank: exit_code}`` and
+        ``first_failure``). Returns the number of epochs used."""
+        self._poller.start()
+        spurious_drains = 0
+        try:
+            while True:
+                if max_epochs is not None and self.epoch >= max_epochs:
+                    raise RuntimeError(
+                        f"elastic: giving up after {self.epoch} epochs")
+                slots = self.rendezvous()
+                job = launch_fn(slots, self.epoch, self.worker_env())
+                job.join()
+                first = job.first_failure
+                if first is None:
+                    logger.info("elastic: job completed in epoch %d",
+                                self.epoch)
+                    return self.epoch
+                rank, rc = first
+                if rc == EXIT_RENDEZVOUS:
+                    # graceful: workers drained at a commit boundary in
+                    # response to a membership interrupt — no blame. A
+                    # drain with NO membership change behind it means the
+                    # command exits 75 on its own: cap it, or hvdrun
+                    # would relaunch in a tight infinite loop.
+                    if self._membership_dirty:
+                        self._membership_dirty = False
+                        spurious_drains = 0
+                    else:
+                        spurious_drains += 1
+                        if spurious_drains >= 3:
+                            raise RuntimeError(
+                                "elastic: workers exited with "
+                                f"EXIT_RENDEZVOUS ({EXIT_RENDEZVOUS}) "
+                                f"{spurious_drains} times with no "
+                                "membership change; treating as a "
+                                "persistent failure")
+                        time.sleep(1.0)
+                    logger.info("elastic: epoch %d drained for "
+                                "re-rendezvous", self.epoch)
+                    continue
+                spurious_drains = 0
+                host = slots[rank].hostname
+                logger.warning(
+                    "elastic: epoch %d rank %d on %s exited with %s "
+                    "(last heartbeat: %s)", self.epoch, rank, host, rc,
+                    self.worker_progress().get(rank))
+                self.blacklist.record_failure(host)
+                self._membership_event(
+                    "FAILURE", {"epoch": self.epoch, "rank": rank,
+                                "host": host, "exit_code": rc})
+        finally:
+            self._poller.stop()
+
+    def stop(self):
+        self._poller.stop()
+
+
+def default_launch_fn(command, controller_port=0, rendezvous_addr=None,
+                      rendezvous_port=None, extra_env=None, ssh_port=None,
+                      output_dir=None, jax_coordinator=False):
+    """Build a ``launch_fn`` for :meth:`ElasticDriver.run_job` that runs
+    ``command`` on real hosts through ``run/launcher.py`` (the hvdrun
+    elastic path). Per-rank logs go to ``output_dir/epoch-<n>/`` so a
+    relaunch never truncates the previous epoch's logs — the evidence of
+    the failure being recovered from. With ``jax_coordinator`` each
+    epoch gets a fresh ``HOROVOD_COORDINATOR_ADDR`` on its first host
+    (the world size changes between epochs, so the coordinator must be
+    re-formed anyway)."""
+    import os
+    import random
+
+    from horovod_tpu.run import launcher
+
+    def launch(slots, epoch, elastic_env):
+        env = dict(extra_env or {})
+        env.update(elastic_env)
+        controller_addr = slots[0].hostname
+        if controller_addr in launcher.LOCAL_HOSTS:
+            controller_addr = "127.0.0.1"
+        if jax_coordinator:
+            from horovod_tpu.run.run import free_port
+            jport = (free_port() if controller_addr == "127.0.0.1"
+                     else random.randint(23000, 43000))
+            env["HOROVOD_COORDINATOR_ADDR"] = f"{controller_addr}:{jport}"
+        out_dir = (os.path.join(output_dir, f"epoch-{epoch}")
+                   if output_dir else None)
+        sys.stderr.write(
+            f"hvdrun: elastic epoch {epoch}: launching "
+            f"{len(slots)} workers\n")
+        return launcher.launch(
+            slots, command, controller_addr, controller_port,
+            rendezvous_addr=rendezvous_addr,
+            rendezvous_port=rendezvous_port, extra_env=env,
+            ssh_port=ssh_port, output_dir=out_dir)
+
+    return launch

@@ -1,0 +1,30 @@
+"""Elastic control-flow exceptions.
+
+Reference: ``horovod/common/exceptions.py`` (0.20+) — ``HorovodInternalError``
+(a collective or peer failed; committed state must be restored) and
+``HostsUpdatedInterrupt`` (membership changed; current state is still good,
+the job only needs to re-rendezvous). The split matters: a failure rolls
+the model back to the last ``commit()``, an update does not.
+"""
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised between batches (at ``State.commit()``) when the driver has
+    signalled a host-membership change. Training state is NOT rolled back;
+    the elastic loop re-syncs and continues under the new world.
+
+    ``res`` records what changed ("added" / "removed" / "updated")."""
+
+    def __init__(self, res="updated"):
+        super().__init__(res)
+        self.res = res
+
+
+class WorkerFailureError(RuntimeError):
+    """A peer worker (or a collective against it) failed mid-batch. The
+    elastic loop restores the last committed state before retrying —
+    partially-applied updates from the failed batch must not survive."""
+
+
+# Reference-compatible alias (``horovod.common.exceptions``).
+HorovodInternalError = WorkerFailureError

@@ -1,0 +1,134 @@
+"""Worker notification plane: how the driver interrupts workers.
+
+Reference: ``horovod/run/common/service/{worker_notification_service,
+compute_service}.py`` — each worker runs a tiny TCP service; when host
+membership changes, the driver posts ``HostsUpdatedRequest`` to every
+worker, and the worker raises :class:`HostsUpdatedInterrupt` at the next
+batch boundary (``State.commit()``/``check_host_updates()``), never
+mid-collective.
+
+Transport is the HMAC-framed JSON protocol from ``run/discovery.py``
+(``digest || u32 len || json``) — one wire format for the whole control
+plane, never pickle.
+"""
+
+import logging
+import socket
+import socketserver
+import threading
+
+from horovod_tpu.elastic.exceptions import HostsUpdatedInterrupt
+from horovod_tpu.run.discovery import recv_frame, send_frame
+
+logger = logging.getLogger("horovod_tpu")
+
+# Unauthenticated single-host runs still need SOME key for the frame MAC;
+# a fixed local key keeps the framing uniform (loopback-only binding is
+# the actual isolation there, as with the launcher KV).
+LOCAL_KEY = b"horovod-tpu-elastic-local"
+
+
+class WorkerNotificationManager:
+    """Worker-side mailbox between the notification service thread and
+    the training loop: the service records interrupts, the loop polls at
+    commit boundaries (reference ``WorkerNotificationManager``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = None
+
+    def handle_hosts_updated(self, res="updated"):
+        with self._lock:
+            self._pending = res
+
+    def poll(self, clear=True):
+        """The pending update reason, or None; clears it by default."""
+        with self._lock:
+            res = self._pending
+            if clear:
+                self._pending = None
+            return res
+
+    def check(self):
+        """Raise :class:`HostsUpdatedInterrupt` if an update is pending
+        (called by ``State.commit()`` — i.e. between batches)."""
+        res = self.poll()
+        if res is not None:
+            raise HostsUpdatedInterrupt(res)
+
+    def reset(self):
+        self.poll()
+
+
+# The default mailbox ``State`` objects check; a worker process has one
+# training loop, so one process-global manager (reference
+# ``horovod.common.elastic.notification_manager``).
+notification_manager = WorkerNotificationManager()
+
+
+class WorkerNotificationService:
+    """Per-worker TCP endpoint the driver posts interrupts to.
+
+    Ops: ``hosts_updated`` (records the interrupt), ``ping`` (liveness
+    probe; answers with the service name, like discovery's PingServer).
+    Bad digests and unknown ops are dropped silently."""
+
+    def __init__(self, key=None, manager=None, host="0.0.0.0", port=0):
+        self._key = key or LOCAL_KEY
+        self.manager = manager if manager is not None else \
+            notification_manager
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                req = recv_frame(self.request, outer._key)
+                if req is None:
+                    return  # bad digest or garbage
+                op = req.get("op")
+                if op == "hosts_updated":
+                    outer.manager.handle_hosts_updated(
+                        req.get("res", "updated"))
+                    send_frame(self.request, outer._key, {"ok": True})
+                elif op == "ping":
+                    send_frame(self.request, outer._key,
+                               {"service": "worker-notification"})
+
+        self._server = socketserver.ThreadingTCPServer((host, port),
+                                                       _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="hvd_tpu_worker_notif",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self):
+        return self._server.socket.getsockname()[1]
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join()
+
+
+class WorkerNotificationClient:
+    """Driver-side handle to one worker's notification service."""
+
+    def __init__(self, addr, port, key=None, timeout=3.0):
+        self._target = (addr, port)
+        self._key = key or LOCAL_KEY
+        self._timeout = timeout
+
+    def _call(self, obj):
+        with socket.create_connection(self._target,
+                                      timeout=self._timeout) as sock:
+            send_frame(sock, self._key, obj)
+            return recv_frame(sock, self._key)
+
+    def notify_hosts_updated(self, res="updated"):
+        resp = self._call({"op": "hosts_updated", "res": res})
+        return bool(resp and resp.get("ok"))
+
+    def ping(self):
+        resp = self._call({"op": "ping"})
+        return bool(resp and resp.get("service") == "worker-notification")

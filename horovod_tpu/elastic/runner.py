@@ -1,0 +1,78 @@
+"""The elastic retry loop: ``@hvd.elastic.run``.
+
+Reference: ``horovod/common/elastic.py`` ``run_fn`` — wraps a training
+function taking a :class:`~horovod_tpu.elastic.state.State` first, and
+implements the recovery policy:
+
+* ``HostsUpdatedInterrupt`` (membership changed, raised at a commit
+  boundary): committed progress is KEPT. Under a driver-managed worker
+  (``HOROVOD_ELASTIC_EPOCH`` set) the process exits with
+  ``EXIT_RENDEZVOUS`` so the driver relaunches it into the new world;
+  in-process (tests, single-process elasticity) the loop re-syncs and
+  retries directly.
+* worker-failure exceptions (``WorkerFailureError`` plus anything passed
+  via ``retryable=``): the last committed state is restored — the
+  half-applied batch is discarded — then the loop re-syncs and retries,
+  up to ``HOROVOD_ELASTIC_RESET_LIMIT`` resets (0 = unlimited).
+"""
+
+import functools
+import logging
+import os
+import sys
+
+from horovod_tpu.elastic.exceptions import (HostsUpdatedInterrupt,
+                                            WorkerFailureError)
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def _driver_managed():
+    """True when this process was launched by an ElasticDriver epoch (so
+    re-rendezvous means exit-and-be-relaunched, not retry-in-place)."""
+    return "HOROVOD_ELASTIC_EPOCH" in os.environ
+
+
+def run(func=None, *, retryable=()):
+    """Decorate ``func(state, *args, **kwargs)`` with the elastic retry
+    loop. ``retryable`` extends the worker-failure exception set (e.g.
+    the RuntimeError a dead peer surfaces as from a collective)."""
+    if func is None:
+        return functools.partial(run, retryable=retryable)
+    failure_excs = (WorkerFailureError,) + tuple(retryable)
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        from horovod_tpu.elastic.driver import EXIT_RENDEZVOUS
+        reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT",
+                                         "0") or 0)
+        resets = 0
+        first = True
+        while True:
+            if not first:
+                state.on_reset()
+            try:
+                state.sync()
+                return func(state, *args, **kwargs)
+            except HostsUpdatedInterrupt as e:
+                # progress is committed; only the world needs rebuilding
+                if _driver_managed():
+                    logger.info("elastic: hosts %s — draining for "
+                                "re-rendezvous", e.res)
+                    sys.exit(EXIT_RENDEZVOUS)
+                logger.info("elastic: hosts %s — re-syncing in process",
+                            e.res)
+                first = False
+            except failure_excs as e:
+                resets += 1
+                if reset_limit and resets > reset_limit:
+                    raise WorkerFailureError(
+                        f"elastic: giving up after {resets - 1} resets "
+                        f"(HOROVOD_ELASTIC_RESET_LIMIT="
+                        f"{reset_limit})") from e
+                logger.warning("elastic: worker failure (%s); restoring "
+                               "last commit (reset %d)", e, resets)
+                state.restore()
+                first = False
+
+    return wrapper

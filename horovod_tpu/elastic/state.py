@@ -1,0 +1,307 @@
+"""Elastic worker state: commit / restore / sync.
+
+Reference: ``horovod/common/elastic.py`` (0.20+) — a ``State`` object the
+training loop commits at batch boundaries. On a peer failure the elastic
+loop calls ``restore()`` (roll back to the last commit, discarding the
+half-applied batch); on a membership change it keeps the state and only
+``sync()``-s so new workers start from the survivors' progress.
+
+``sync()`` broadcasts from the **lowest-rank committed** worker through
+the existing collective plane (``ops.collective.broadcast`` via
+``hvd.broadcast_variables``) so a freshly (re)spawned worker adopts the
+survivors' state; disk-backed commits ride ``checkpoint.py`` so state
+also survives full process loss (the launcher-restart recovery mode,
+docs/ELASTIC.md).
+"""
+
+import copy
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger("horovod_tpu")
+
+# "No committed state" sentinel for the lowest-committed-rank election;
+# must beat any real rank in a Min reduction.
+_UNCOMMITTED = 1 << 30
+
+
+def _env_rank():
+    import horovod_tpu as hvd
+    if hvd.is_initialized():
+        return hvd.rank()
+    return int(os.environ.get("HOROVOD_RANK", "0"))
+
+
+class State:
+    """Base elastic state (reference ``State``): subclasses define what
+    ``save``/``restore``/``sync`` mean for their payload.
+
+    ``commit()`` is the batch-boundary hook: it saves a restore point and
+    then surfaces any pending membership interrupt — so an interrupt can
+    never land mid-batch and the committed snapshot always reflects a
+    completed batch."""
+
+    def __init__(self, notification_manager=None):
+        if notification_manager is None:
+            from horovod_tpu.elastic.notification import notification_manager \
+                as default_manager
+            notification_manager = default_manager
+        self._notification_manager = notification_manager
+        self._reset_callbacks = []
+
+    def register_reset_callbacks(self, callbacks):
+        """Callbacks run by the elastic loop after a reset (re-rendezvous)
+        — e.g. rebuild a jitted step for a new world size."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self):
+        self.save()
+        self._heartbeat()
+        self.check_host_updates()
+
+    def _heartbeat(self):
+        """Every commit doubles as a liveness signal. When a stall
+        inspector is live (``hvd.init()`` under HOROVOD_ELASTIC=1), the
+        commit feeds ``record_progress`` — resetting the stall watchdog
+        AND firing its listeners, which include the KV heartbeat
+        publisher (worker.attach_progress_reporter). Without one, the
+        heartbeat is published directly."""
+        step = self._progress_step()
+        inspector = None
+        try:
+            from horovod_tpu import basics
+            inspector = basics._state.stall_inspector
+        except Exception:
+            pass
+        if inspector is not None:
+            inspector.record_progress(step)
+        from horovod_tpu.elastic import worker
+        ctx = worker.get_worker_context()
+        if ctx is not None and not (inspector is not None
+                                    and ctx.attached_to_inspector):
+            ctx.report_progress(step)
+
+    def _progress_step(self):
+        """Best-effort step counter for the heartbeat: a ``step``
+        attribute on the state itself, or on any held value (e.g. a
+        whole TrainState under ``train_state``)."""
+        candidates = [getattr(self, "step", None)]
+        candidates += [getattr(getattr(self, k, None), "step", None)
+                       for k in getattr(self, "_state_keys", ())]
+        for cand in candidates:
+            if cand is None:
+                continue
+            try:
+                return int(np.asarray(cand))
+            except (TypeError, ValueError):
+                continue
+        return None
+
+    def check_host_updates(self):
+        """Raise ``HostsUpdatedInterrupt`` if the driver flagged a
+        membership change since the last check."""
+        self._notification_manager.check()
+
+    # -- subclass payload hooks ---------------------------------------------
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ObjectState(State):
+    """Plain-Python attribute state (reference ``ObjectState``): every
+    keyword becomes an attribute; commit deep-copies them, restore puts
+    the copies back, sync adopts the lowest committed rank's values via
+    the collective plane (pickle-free: values must be tree-mappable)."""
+
+    def __init__(self, notification_manager=None, **kwargs):
+        super().__init__(notification_manager=notification_manager)
+        self._saved_state = None
+        self._state_keys = sorted(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def _capture(self):
+        return {k: copy.deepcopy(getattr(self, k))
+                for k in self._state_keys}
+
+    def _adopt(self, values):
+        for k in self._state_keys:
+            setattr(self, k, copy.deepcopy(values[k]))
+
+    def save(self):
+        self._saved_state = self._capture()
+
+    def restore(self):
+        if self._saved_state is not None:
+            self._adopt(self._saved_state)
+
+    def has_commit(self):
+        return self._saved_state is not None
+
+    def sync(self, root_rank=None):
+        """Adopt the committed state of ``root_rank`` (default: the
+        lowest rank that has committed; the election and the broadcast
+        both ride the collective plane, so this is a collective call)."""
+        root = _elect_root(root_rank, self.has_commit())
+        if root is None:
+            # nobody has progress: baseline is the fresh init — but the
+            # init must still be BROADCAST from rank 0 (reference sync
+            # semantics) or rank-dependent initialization would train
+            # silently divergent models. After a driver relaunch this is
+            # almost certainly LOST progress (e.g. a checkpoint
+            # directory not on shared storage) — say so loudly instead
+            # of silently retraining from step 0.
+            epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0") or 0)
+            if epoch > 1:
+                logger.warning(
+                    "elastic: no committed state found on any rank after "
+                    "a relaunch (epoch %d) — training restarts from the "
+                    "fresh initialization. Put JaxState(directory=...) "
+                    "on storage every replacement worker can read for "
+                    "cross-relaunch continuity.", epoch)
+            self._adopt(_broadcast_tree(self._capture(), 0))
+            self.save()
+            return
+        payload = (self._saved_state if self.has_commit()
+                   else self._capture())
+        synced = _broadcast_tree(payload, root)
+        self._adopt(synced)
+        self._saved_state = self._capture()
+
+
+class JaxState(ObjectState):
+    """JAX-native elastic state: keyword pytrees (``params``,
+    ``opt_state``, a whole ``TrainState``, scalars...) with
+
+    * **commit** — pulls every leaf to host memory (``device_get``) and,
+      when ``directory`` is given, writes a ``checkpoint.py`` msgpack
+      from rank 0 (atomic; survives full process loss),
+    * **restore** — re-adopts the last in-memory commit, falling back to
+      the newest on-disk checkpoint for freshly (re)spawned workers,
+    * **sync** — broadcasts the trees from the lowest committed rank via
+      ``ops.collective`` so surviving workers hand their progress to new
+      ones without touching disk.
+    """
+
+    def __init__(self, directory=None, keep=3, notification_manager=None,
+                 **kwargs):
+        super().__init__(notification_manager=notification_manager,
+                         **kwargs)
+        self._directory = directory
+        self._keep = keep
+        self._commit_count = 0
+
+    def _capture(self):
+        import jax
+        return {k: jax.tree_util.tree_map(
+                    lambda x: np.asarray(jax.device_get(x)),
+                    getattr(self, k))
+                for k in self._state_keys}
+
+    def _adopt(self, values):
+        for k in self._state_keys:
+            setattr(self, k, values[k])
+
+    def save(self):
+        self._saved_state = self._capture()
+        self._commit_count += 1
+        if self._directory and _env_rank() == 0:
+            from horovod_tpu import checkpoint
+            # flax msgpack only knows plain containers, but state may
+            # hold custom pytree nodes (e.g. a whole TrainState): ship
+            # flattened leaves and rebuild against the live structure
+            payload = {k: _leaf_dict(v)
+                       for k, v in self._saved_state.items()}
+            checkpoint.write_checkpoint(
+                self._directory, self._commit_count, payload,
+                meta={"commit": self._commit_count}, keep=self._keep)
+
+    def restore(self):
+        if self._saved_state is None:
+            self._restore_from_disk()
+        super().restore()
+
+    def _restore_from_disk(self):
+        if not self._directory:
+            return False
+        from horovod_tpu import checkpoint
+        steps = checkpoint.list_steps(self._directory)
+        if not steps:
+            return False
+        target = {k: _leaf_dict(v)  # flax restores by target structure
+                  for k, v in self._capture().items()}
+        restored, _opt, meta = checkpoint.restore_checkpoint(
+            self._directory, steps[-1], target)
+        self._saved_state = {k: _unflatten_like(getattr(self, k),
+                                                restored[k])
+                             for k in self._state_keys}
+        self._commit_count = int(meta.get("commit", steps[-1]))
+        logger.info("elastic: restored commit %d from %s",
+                    self._commit_count, self._directory)
+        return True
+
+    def sync(self, root_rank=None):
+        # A respawned worker first picks up any on-disk commit so the
+        # committed-rank election sees its real progress.
+        if self._saved_state is None:
+            self._restore_from_disk()
+            super().restore()
+        super().sync(root_rank=root_rank)
+
+
+def _leaf_dict(tree):
+    """Flatten a pytree into ``{"0": leaf, "1": leaf, ...}`` (host
+    numpy). Checkpoints store this form so custom pytree nodes survive
+    the msgpack roundtrip; structure comes from the live state."""
+    import jax
+    return {str(i): np.asarray(jax.device_get(leaf))
+            for i, leaf in enumerate(jax.tree_util.tree_leaves(tree))}
+
+
+def _unflatten_like(tree, leaf_dict):
+    """Rebuild ``tree``'s structure from a :func:`_leaf_dict` payload."""
+    import jax
+    treedef = jax.tree_util.tree_structure(tree)
+    leaves = [leaf_dict[str(i)] for i in range(len(leaf_dict))]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _elect_root(root_rank, has_commit):
+    """The broadcast root: the explicit ``root_rank`` or the lowest rank
+    that has a commit (Min-allreduce election); None when no rank has
+    committed anything (single-process: this process's own view)."""
+    if root_rank is not None:
+        return root_rank
+    import horovod_tpu as hvd
+    if not (hvd.is_initialized() and hvd.size() > 1):
+        return 0 if has_commit else None
+    from horovod_tpu.ops import collective
+    me = _env_rank() if has_commit else _UNCOMMITTED
+    root = int(np.asarray(collective.allreduce(
+        np.asarray(me, dtype=np.int32), op=collective.Min)))
+    return None if root >= _UNCOMMITTED else root
+
+
+def _broadcast_tree(tree, root):
+    """Broadcast every leaf of ``tree`` from ``root`` over the collective
+    plane (identity when not running multi-process)."""
+    import horovod_tpu as hvd
+    if not (hvd.is_initialized() and hvd.size() > 1):
+        return tree
+    return hvd.broadcast_variables(tree, root_rank=root)

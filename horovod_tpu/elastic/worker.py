@@ -1,0 +1,132 @@
+"""Worker-side elastic context: notification endpoint + heartbeats.
+
+The worker half of the driver contract (elastic/driver.py):
+
+* starts a :class:`~horovod_tpu.elastic.notification.
+  WorkerNotificationService` and publishes its endpoint on the launcher
+  KV (``elastic/notif/<epoch>/<rank>``) so the driver can interrupt this
+  worker between batches,
+* publishes step heartbeats (``elastic/heartbeat/<epoch>/<rank>``) — fed
+  by ``runtime/stall.py``'s progress hooks, they are the driver's
+  liveness view of this worker.
+
+Workers launched by the elastic driver get ``HOROVOD_ELASTIC=1`` and
+``HOROVOD_ELASTIC_EPOCH`` in their env; ``init_worker_context()`` reads
+the rest of the standard launcher contract (rank, rendezvous KV address,
+secret key).
+"""
+
+import json
+import logging
+import os
+import socket
+import time
+
+from horovod_tpu.elastic import notification
+from horovod_tpu.run import secret as _secret
+from horovod_tpu.run.rendezvous import kv_put
+
+logger = logging.getLogger("horovod_tpu")
+
+_context = None
+
+
+def is_elastic_worker(env=None):
+    return (env or os.environ).get("HOROVOD_ELASTIC") == "1"
+
+
+class WorkerContext:
+    """One elastic worker's control-plane attachments."""
+
+    def __init__(self, rank=None, epoch=None, kv_addr=None, kv_port=None,
+                 auth_key=None):
+        env = os.environ
+        self.rank = rank if rank is not None else int(
+            env.get("HOROVOD_RANK", "0"))
+        self.epoch = epoch if epoch is not None else int(
+            env.get("HOROVOD_ELASTIC_EPOCH", "0"))
+        self._kv_addr = kv_addr or env.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
+        self._kv_port = int(kv_port or
+                            env.get("HOROVOD_GLOO_RENDEZVOUS_PORT", "0"))
+        self._key = auth_key if auth_key is not None else \
+            _secret.key_from_env()
+        self.attached_to_inspector = False
+        self.manager = notification.notification_manager
+        # no per-run key (all-local job) -> the fixed LOCAL_KEY provides
+        # no secrecy, so loopback binding must be the isolation; only
+        # authenticated multi-host runs listen on the network
+        self.service = notification.WorkerNotificationService(
+            key=self._key, manager=self.manager,
+            host="0.0.0.0" if self._key else "127.0.0.1")
+        self._publish_endpoint()
+
+    def _advertised_addr(self):
+        """An address the DRIVER can dial: this host's primary IP, or
+        loopback when resolution fails / the job is launcher-local."""
+        if not self._key:
+            return "127.0.0.1"  # matches the loopback-only bind above
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+    def _kv_ready(self):
+        return bool(self._kv_addr) and self._kv_port > 0
+
+    def _publish_endpoint(self):
+        if not self._kv_ready():
+            logger.debug("elastic: no rendezvous KV; notification "
+                         "endpoint not published")
+            return
+        payload = {"addr": self._advertised_addr(),
+                   "port": self.service.port}
+        kv_put(self._kv_addr, self._kv_port,
+               f"elastic/notif/{self.epoch}/{self.rank}",
+               json.dumps(payload).encode(), auth_key=self._key)
+
+    def report_progress(self, step=None):
+        """Publish a heartbeat; wired into ``StallInspector.
+        record_progress`` via :func:`attach_progress_reporter` so every
+        completed step refreshes the driver's liveness view."""
+        if not self._kv_ready():
+            return
+        payload = {"step": step, "time": time.time()}
+        try:
+            kv_put(self._kv_addr, self._kv_port,
+                   f"elastic/heartbeat/{self.epoch}/{self.rank}",
+                   json.dumps(payload).encode(), auth_key=self._key)
+        except OSError:
+            pass  # the launcher KV going away must never kill a step
+
+    def shutdown(self):
+        self.service.shutdown()
+
+
+def init_worker_context(**kwargs):
+    """Create (once) and return this process's :class:`WorkerContext`."""
+    global _context
+    if _context is None:
+        _context = WorkerContext(**kwargs)
+    return _context
+
+
+def get_worker_context():
+    return _context
+
+
+def shutdown_worker_context():
+    global _context
+    if _context is not None:
+        _context.shutdown()
+        _context = None
+
+
+def attach_progress_reporter(inspector, context=None):
+    """Register the heartbeat publisher as a progress listener on a
+    ``runtime.stall.StallInspector`` — the bridge named in the elastic
+    design: stall-inspector progress hooks feed the driver's liveness
+    view."""
+    ctx = context or init_worker_context()
+    inspector.add_progress_listener(ctx.report_progress)
+    ctx.attached_to_inspector = True
+    return ctx

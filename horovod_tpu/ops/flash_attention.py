@@ -400,6 +400,19 @@ def _fit_block(n, preferred):
     return 0
 
 
+MIN_BLOCK = 128  # MXU tile width: narrower blocks starve the systolic array
+
+
+def _block_ok(n, preferred):
+    """A fitted block is worth running only when it either spans the
+    whole (short) sequence or meets the MXU floor: a long sequence whose
+    only fitting block is tiny (e.g. 1048 -> 8) would issue 8-wide MXU
+    ops all the way down — slower than the dense XLA path it replaces
+    (ADVICE round 5)."""
+    b = _fit_block(n, preferred)
+    return b > 0 and (b == n or b >= MIN_BLOCK)
+
+
 def kernel_supported(sq, skv, d, block_q=DEFAULT_BLOCK_Q,
                      block_k=DEFAULT_BLOCK_K):
     """True when these shapes tile onto the kernel (callers use this to
@@ -407,9 +420,10 @@ def kernel_supported(sq, skv, d, block_q=DEFAULT_BLOCK_Q,
     if pltpu is None:
         return False
     # blocks must respect the fp32 sublane tile (8) or Mosaic can
-    # reject the lowering — the fallback contract depends on this gate
-    return (d % 8 == 0 and _fit_block(sq, block_q) > 0
-            and _fit_block(skv, block_k) > 0)
+    # reject the lowering — the fallback contract depends on this gate —
+    # and clear the MXU floor, or the dense fallback is faster
+    return (d % 8 == 0 and _block_ok(sq, block_q)
+            and _block_ok(skv, block_k))
 
 
 def _prep(q, k, v, sm_scale, block_q, block_k, interpret):

@@ -118,6 +118,12 @@ def _recv_exact(sock, n):
     return buf
 
 
+# Public names for the frame protocol: the elastic worker-notification
+# plane (elastic/notification.py) speaks the same signed framing.
+send_frame = _send_frame
+recv_frame = _recv_frame
+
+
 class PingServer:
     """Per-task reachability prober target (the role of the reference
     task service's PingRequest handler, ``network.py:115-117``): answers a

@@ -128,6 +128,7 @@ class Job:
         self.procs = []
         self._failed = threading.Event()
         self.first_failure = None
+        self.exit_codes = {}
         self._lock = threading.Lock()
 
     def kill_all(self, sig=signal.SIGTERM):
@@ -140,6 +141,8 @@ class Job:
 
     def _monitor(self, rank, proc):
         rc = proc.wait()
+        with self._lock:
+            self.exit_codes[rank] = rc
         # release this worker's middleman death-pipe write end (spawn());
         # without this a long-lived driver leaks one fd per worker launch
         death_w = getattr(proc, "_hvd_death_w", None)
@@ -159,6 +162,19 @@ class Job:
     def wait(self):
         """Block until all processes exit; raise on any failure
         (reference gloo_run.py:253-259)."""
+        self.join()
+        if self.first_failure is not None:
+            rank, rc = self.first_failure
+            raise RuntimeError(
+                f"hvdrun: process with rank {rank} exited with code {rc}; "
+                f"remaining processes were terminated")
+
+    def join(self):
+        """Like :meth:`wait`, but return ``{rank: exit_code}`` instead of
+        raising. The kill-on-first-failure fan-out still applies; the
+        elastic driver inspects ``first_failure`` to decide whom to blame
+        (only the FIRST failing rank — the rest died from our own
+        SIGTERM)."""
         threads = [threading.Thread(target=self._monitor, args=(r, p))
                    for r, p in enumerate(self.procs)]
         for t in threads:
@@ -171,11 +187,7 @@ class Job:
             for t in threads:
                 t.join()
             raise
-        if self.first_failure is not None:
-            rank, rc = self.first_failure
-            raise RuntimeError(
-                f"hvdrun: process with rank {rank} exited with code {rc}; "
-                f"remaining processes were terminated")
+        return dict(self.exit_codes)
 
 
 def this_host_addr():

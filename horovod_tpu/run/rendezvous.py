@@ -130,6 +130,10 @@ class KVStoreServer:
         with self._handler_cls.lock:
             self._handler_cls.store[key] = value
 
+    def delete(self, key):
+        with self._handler_cls.lock:
+            self._handler_cls.store.pop(key, None)
+
 
 def _headers(auth_key, method, key, body=b""):
     if auth_key is None:

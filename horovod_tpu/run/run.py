@@ -95,6 +95,23 @@ def build_parser():
     p.add_argument("--no-interface-discovery", action="store_true",
                    help="skip the multi-host NIC discovery pre-flight")
 
+    el = p.add_argument_group(
+        "elastic (reference: horovodrun --min-np/--max-np/"
+        "--host-discovery-script)")
+    el.add_argument("--min-np", type=int, default=None,
+                    help="minimum worker count: the job keeps running as "
+                         "long as this many slots remain after failures/"
+                         "blacklisting (enables elastic mode)")
+    el.add_argument("--max-np", type=int, default=None,
+                    help="maximum worker count when discovery reports "
+                         "more slots than needed")
+    el.add_argument("--host-discovery-script", default=None,
+                    help="executable printing the current 'host:slots' "
+                         "set, one per line; polled for membership "
+                         "changes (enables elastic mode)")
+    el.add_argument("--elastic-poll-interval", type=float, default=2.0,
+                    help="seconds between host-discovery polls")
+
     tune = p.add_argument_group("tuning (sets HOROVOD_* env)")
     tune.add_argument("--fusion-threshold-mb", type=int, default=None)
     tune.add_argument("--cycle-time-ms", type=float, default=None)
@@ -142,10 +159,53 @@ def parse_args(argv=None):
     if args.config_file:
         defaults = {a.dest: a.default for a in parser._actions}
         config_parser.load_config_file(args.config_file, args, defaults)
+    args.elastic = _validate_elastic_args(parser, args)
     # after the config overlay: the YAML may supply num-proc
-    if not args.check_build and args.num_proc is None:
+    if not args.check_build and not args.elastic and args.num_proc is None:
         parser.error("-np/--num-proc is required")
     return args
+
+
+def _validate_elastic_args(parser, args):
+    """Reject invalid elastic flag combinations with actionable errors;
+    returns True when the job is elastic (any elastic flag present) and
+    normalizes min/max/np defaults."""
+    elastic = (args.min_np is not None or args.max_np is not None
+               or args.host_discovery_script is not None)
+    if not elastic:
+        return False
+    if args.host_discovery_script is not None:
+        if args.hosts or args.hostfile:
+            parser.error("--host-discovery-script replaces -H/--hostfile: "
+                         "the script is the source of truth for the host "
+                         "set; pass one or the other")
+        script = args.host_discovery_script
+        if not os.path.isfile(script):
+            parser.error(f"--host-discovery-script {script!r} does not "
+                         "exist")
+        if not os.access(script, os.X_OK):
+            parser.error(f"--host-discovery-script {script!r} is not "
+                         "executable (chmod +x it)")
+    if args.min_np is None:
+        if args.num_proc is None:
+            parser.error("elastic mode requires --min-np (or -np, which "
+                         "defaults --min-np)")
+        args.min_np = args.num_proc
+    if args.min_np < 1:
+        parser.error(f"--min-np must be >= 1 (got {args.min_np})")
+    if args.max_np is not None and args.max_np < args.min_np:
+        parser.error(f"--max-np ({args.max_np}) must be >= --min-np "
+                     f"({args.min_np})")
+    if args.num_proc is not None:
+        if args.num_proc < args.min_np:
+            parser.error(f"-np ({args.num_proc}) must be >= --min-np "
+                         f"({args.min_np})")
+        if args.max_np is not None and args.num_proc > args.max_np:
+            parser.error(f"-np ({args.num_proc}) must be <= --max-np "
+                         f"({args.max_np})")
+    else:
+        args.num_proc = args.min_np
+    return True
 
 
 def free_port():
@@ -200,15 +260,80 @@ def _discover_interfaces(hosts, auth_key, kv_port, args, extra_env):
     return common
 
 
+def _nic_cache_key(hosts):
+    """Cache key for the NIC pre-flight: keyed by the LAUNCHER host too —
+    the elected set is an intersection over paths from this machine, so a
+    shared home directory must not let launcher A serve launcher B's
+    answer (ADVICE round 5)."""
+    return ("nics:" + socket.gethostname() + ":"
+            + ",".join(sorted({h.hostname for h in hosts})))
+
+
+def _common_interfaces(args, hosts, discover_fn):
+    """Same host set within the TTL -> same routable NICs: serve the
+    pre-flight from the launcher cache (reference run/util/cache.py
+    behavior; --disable-cache forces a fresh probe). Both the cached and
+    the fresh path return ``sorted(common)`` so first and subsequent
+    launches export identical HOROVOD_COMMON_INTERFACES."""
+    cache_key = _nic_cache_key(hosts)
+    nic_cache = run_cache.Cache()
+    common = (None if getattr(args, "disable_cache", False)
+              else nic_cache.get(cache_key))
+    if common is None:
+        common = sorted(discover_fn())
+        nic_cache.put(cache_key, common)
+    elif args.verbose:
+        print(f"hvdrun: cached routable interfaces: {common}",
+              file=sys.stderr)
+    return common
+
+
+def _host_list_from_args(args):
+    """The -H / --hostfile / localhost-default host list (shared by the
+    fixed-size and elastic launch paths)."""
+    if args.hostfile:
+        return allocation.parse_hostfile(args.hostfile)
+    if args.hosts:
+        return allocation.parse_hosts(args.hosts)
+    return [allocation.HostSlots("localhost", args.num_proc)]
+
+
+def _start_kv(all_local):
+    """The launch-time KV server with the shared auth policy: multi-host
+    runs get a per-run HMAC key and a network bind; all-local runs bind
+    loopback unauthenticated (reference secret.py + network.py Wire).
+    Returns ``(kv, auth_key, port)``."""
+    auth_key = None if all_local else _secret.make_secret_key()
+    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0",
+                       auth_key=auth_key)
+    return kv, auth_key, kv.start()
+
+
+def _base_worker_env(args, auth_key, all_local, hosts, rendezvous_port):
+    """extra_env shared by both launch paths: tuning knobs, the run
+    secret, and HOROVOD_COMMON_INTERFACES (explicit --nic, or the cached
+    / fresh NIC pre-flight for multi-host jobs)."""
+    extra_env = config_parser.args_to_env(args)
+    if auth_key is not None:
+        extra_env[_secret.SECRET_ENV] = _secret.encode_key(auth_key)
+    if args.nic:
+        extra_env["HOROVOD_COMMON_INTERFACES"] = args.nic
+    elif not all_local and hosts and not args.no_interface_discovery:
+        common = _common_interfaces(
+            args, hosts,
+            lambda: _discover_interfaces(hosts, auth_key, rendezvous_port,
+                                         args, extra_env))
+        if common:
+            extra_env["HOROVOD_COMMON_INTERFACES"] = ",".join(common)
+    return extra_env
+
+
 def _run(args):
     if not args.command:
         raise SystemExit("hvdrun: no training command given")
-    if args.hostfile:
-        hosts = allocation.parse_hostfile(args.hostfile)
-    elif args.hosts:
-        hosts = allocation.parse_hosts(args.hosts)
-    else:
-        hosts = [allocation.HostSlots("localhost", args.num_proc)]
+    if args.elastic:
+        return _run_elastic(args)
+    hosts = _host_list_from_args(args)
     slots = allocation.allocate(hosts, args.num_proc)
 
     # the native-core coordinator lives in rank 0's process on the first
@@ -220,37 +345,10 @@ def _run(args):
         controller_addr = "127.0.0.1"
     controller_port = 0
 
-    # multi-host runs get a per-run HMAC key; the KV then rejects any
-    # unauthenticated request (reference secret.py + network.py Wire)
     all_local = all(s.hostname in launcher.LOCAL_HOSTS for s in slots)
-    auth_key = None if all_local else _secret.make_secret_key()
-    kv = KVStoreServer(host="127.0.0.1" if all_local else "0.0.0.0",
-                       auth_key=auth_key)
-    rendezvous_port = kv.start()
-
-    extra_env = config_parser.args_to_env(args)
-    if auth_key is not None:
-        extra_env[_secret.SECRET_ENV] = _secret.encode_key(auth_key)
-    if args.nic:
-        extra_env["HOROVOD_COMMON_INTERFACES"] = args.nic
-    elif not all_local and not args.no_interface_discovery:
-        # same host set within the TTL -> same routable NICs: serve the
-        # pre-flight from the launcher cache (reference run/util/cache.py
-        # behavior; --disable-cache forces a fresh probe)
-        cache_key = "nics:" + ",".join(
-            sorted({h.hostname for h in hosts}))
-        nic_cache = run_cache.Cache()
-        common = (None if getattr(args, "disable_cache", False)
-                  else nic_cache.get(cache_key))
-        if common is None:
-            common = _discover_interfaces(hosts, auth_key, rendezvous_port,
-                                          args, extra_env)
-            nic_cache.put(cache_key, sorted(common))
-        elif args.verbose:
-            print(f"hvdrun: cached routable interfaces: {common}",
-                  file=sys.stderr)
-        if common:
-            extra_env["HOROVOD_COMMON_INTERFACES"] = ",".join(common)
+    kv, auth_key, rendezvous_port = _start_kv(all_local)
+    extra_env = _base_worker_env(args, auth_key, all_local, hosts,
+                                 rendezvous_port)
     if args.jax_coordinator:
         # probing is only sound for a local rank 0; remote gets a random
         # high port (collision unlikely, bind failure is loud)
@@ -273,6 +371,56 @@ def _run(args):
         kv.stop()
 
 
+def _run_elastic(args):
+    """The elastic launch path: an ElasticDriver owns discovery,
+    blacklisting and per-epoch rendezvous; each epoch launches
+    ``args.command`` through the normal launcher with the elastic env
+    contract on top (HOROVOD_ELASTIC / _EPOCH / _MIN_NP / _MAX_NP)."""
+    from horovod_tpu.elastic.discovery import FixedHosts, ScriptDiscovery
+    from horovod_tpu.elastic.driver import ElasticDriver, default_launch_fn
+
+    if args.host_discovery_script:
+        discovery = ScriptDiscovery(args.host_discovery_script)
+    else:
+        discovery = FixedHosts(_host_list_from_args(args))
+    initial_hosts = discovery.find_available_hosts_and_slots()
+
+    # dynamic membership may add remote hosts later, so only a fixed
+    # all-local set gets the loopback-bound, unauthenticated KV
+    all_local = (not args.host_discovery_script and
+                 all(h in launcher.LOCAL_HOSTS for h in initial_hosts))
+    kv, auth_key, rendezvous_port = _start_kv(all_local)
+    # NIC pre-flight against the INITIAL host set; hosts that join later
+    # are assumed to share the elected interface naming (docs/ELASTIC.md)
+    initial_host_list = [allocation.HostSlots(h, s)
+                         for h, s in sorted(initial_hosts.items())]
+    extra_env = _base_worker_env(args, auth_key, all_local,
+                                 initial_host_list, rendezvous_port)
+
+    # without an explicit --max-np the job never grows beyond -np: the
+    # requested size is the ceiling, elasticity only rides out losses
+    max_np = args.max_np if args.max_np is not None else args.num_proc
+    driver = ElasticDriver(
+        discovery, args.min_np, max_np=max_np, kv=kv,
+        auth_key=auth_key, poll_interval=args.elastic_poll_interval,
+        start_timeout=args.start_timeout)
+    launch = default_launch_fn(
+        args.command, controller_port=0,
+        rendezvous_addr=("127.0.0.1" if all_local
+                         else launcher.this_host_addr()),
+        rendezvous_port=rendezvous_port, extra_env=extra_env,
+        ssh_port=args.ssh_port, output_dir=args.output_dir,
+        jax_coordinator=args.jax_coordinator)
+    try:
+        epochs = driver.run_job(launch)
+        if args.verbose:
+            print(f"hvdrun: elastic job completed after {epochs} epoch(s)",
+                  file=sys.stderr)
+    finally:
+        driver.stop()
+        kv.stop()
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.check_build:
@@ -280,7 +428,7 @@ def main(argv=None):
         return 0
     try:
         _run(args)
-    except RuntimeError as e:
+    except (RuntimeError, TimeoutError) as e:
         print(str(e), file=sys.stderr)
         return 1
     except KeyboardInterrupt:

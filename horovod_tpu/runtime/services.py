@@ -78,11 +78,24 @@ def start(state):
         state.controller = _core
         logger.info("native core started (controller %s:%d)",
                     cfg.controller_addr, cfg.controller_port)
-    if not cfg.stall_check_disable and state.controller is not None:
+    # elastic workers need the inspector even without the native core:
+    # its progress hooks publish the heartbeats that form the elastic
+    # driver's liveness view (elastic/worker.py)
+    elastic = os.environ.get("HOROVOD_ELASTIC") == "1"
+    if not cfg.stall_check_disable and (state.controller is not None
+                                        or elastic):
         from horovod_tpu.runtime.stall import StallInspector
         state.stall_inspector = StallInspector(
             warning_time=cfg.stall_warning_time,
             shutdown_time=cfg.stall_shutdown_time)
+        if elastic:
+            try:
+                from horovod_tpu.elastic import worker as elastic_worker
+                elastic_worker.attach_progress_reporter(
+                    state.stall_inspector)
+            except Exception:
+                logger.warning("elastic worker context failed to attach",
+                               exc_info=True)
         state.stall_inspector.start()
 
 
@@ -90,6 +103,9 @@ def stop(state):
     if state.stall_inspector is not None:
         state.stall_inspector.stop()
         state.stall_inspector = None
+    if os.environ.get("HOROVOD_ELASTIC") == "1":
+        from horovod_tpu.elastic import worker as elastic_worker
+        elastic_worker.shutdown_worker_context()
     if state.controller is not None:
         state.controller.shutdown()
         state.controller = None

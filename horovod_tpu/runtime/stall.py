@@ -31,13 +31,26 @@ class StallInspector:
         self._stop_event = threading.Event()
         self._thread = None
         self._warned = False
+        self._progress_listeners = []
         self.shutdown_requested = False
 
-    def record_progress(self):
+    def add_progress_listener(self, fn):
+        """Register ``fn(step)`` to run on every ``record_progress`` —
+        the elastic worker context hooks its driver-facing heartbeat here
+        (elastic/worker.py), turning local step progress into the
+        driver's liveness view."""
+        self._progress_listeners.append(fn)
+
+    def record_progress(self, step=None):
         """Call once per completed step (the analogue of a tensor being
         submitted by this rank)."""
         self._last_progress = time.monotonic()
         self._warned = False
+        for fn in list(self._progress_listeners):
+            try:
+                fn(step)
+            except Exception:
+                logger.debug("progress listener failed", exc_info=True)
 
     def start(self):
         self._thread = threading.Thread(target=self._loop,

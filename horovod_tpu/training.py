@@ -161,6 +161,40 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     return step
 
 
+def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
+                       commit_every=1, on_step=None):
+    """Drive ``train_step`` under the elastic retry loop
+    (``hvd.elastic.run``): commit/restore/sync semantics come from
+    ``elastic_state`` (a ``hvd.elastic.JaxState`` whose ``train_state``
+    attribute holds the :class:`TrainState`), membership interrupts are
+    honored at commit boundaries, and a worker failure rolls back to the
+    last commit before retrying.
+
+    ``batch_fn(step) -> (inputs, labels)`` supplies data (step-indexed so
+    a restored worker re-reads the right batch); ``on_step(step, loss)``
+    is an optional observer. Returns the final ``TrainState``.
+    """
+    from horovod_tpu import elastic as _elastic
+
+    def _step_of(ts):
+        return int(jax.device_get(ts.step))
+
+    @_elastic.run
+    def _loop(state):
+        while _step_of(state.train_state) < num_steps:
+            inputs, labels = batch_fn(_step_of(state.train_state))
+            new_ts, loss = train_step(state.train_state, inputs, labels)
+            state.train_state = new_ts
+            done = _step_of(new_ts)
+            if on_step is not None:
+                on_step(done, float(jax.device_get(loss)))
+            if done % commit_every == 0 or done >= num_steps:
+                state.commit()
+        return state.train_state
+
+    return _loop(elastic_state)
+
+
 def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
                        seq_axis=None, donate=True):
     """Build a jitted SPMD language-model train step (next-token loss).

@@ -74,6 +74,12 @@ class Timeline:
         if self._mark_cycles:
             self.instant(f"CYCLE_{n}")
 
+    def membership(self, event, details=None):
+        """Instant marker for an elastic-membership change (host set
+        updated, rendezvous epoch opened, worker failure blamed) so
+        recovery gaps are visible next to the step trace."""
+        self.instant(f"MEMBERSHIP_{event}", args=details or None)
+
     # -- writer thread -------------------------------------------------------
     def _writer_loop(self):
         first = True

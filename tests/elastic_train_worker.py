@@ -1,0 +1,85 @@
+"""Worker body for the elastic integration tests (test_elastic.py).
+
+Runs a tiny deterministic SGD loop (scalar quadratic) under the elastic
+state/commit contract, publishing heartbeats through a StallInspector
+progress hook. Behavior is driven by env/argv so the test can simulate a
+host that keeps dying:
+
+    argv: <ckpt_dir> <log_path> <num_steps> [die_host [die_until_epoch]]
+
+A worker whose HOROVOD_HOSTNAME == die_host and epoch < die_until_epoch
+SIGKILLs itself after committing one step — the "worker killed
+mid-training" scenario. Only rank 0 appends to the loss log, so the log
+is the single continuous loss trajectory across incarnations.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu import elastic  # noqa: E402
+from horovod_tpu.runtime.stall import StallInspector  # noqa: E402
+
+TARGET = 3.0
+LR = 0.2
+
+
+def main():
+    ckpt_dir, log_path, num_steps = (sys.argv[1], sys.argv[2],
+                                     int(sys.argv[3]))
+    die_host = sys.argv[4] if len(sys.argv) > 4 else None
+    die_until_epoch = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    host = os.environ.get("HOROVOD_HOSTNAME", "localhost")
+    epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+
+    ctx = elastic.init_worker_context()
+    inspector = StallInspector(warning_time=600)
+    elastic.attach_progress_reporter(inspector, context=ctx)
+
+    state = elastic.JaxState(directory=ckpt_dir,
+                             params={"w": np.float64(0.0)},
+                             step=np.int64(0))
+    entry_step = {"v": None}
+
+    step_sleep = float(os.environ.get("HVD_ELASTIC_TEST_SLEEP", "0") or 0)
+
+    @elastic.run
+    def train(state):
+        if entry_step["v"] is None:
+            entry_step["v"] = int(state.step)
+        while int(state.step) < num_steps:
+            if step_sleep:
+                time.sleep(step_sleep)
+            w = float(state.params["w"])
+            loss = (w - TARGET) ** 2
+            state.params = {"w": np.float64(w - LR * 2 * (w - TARGET))}
+            state.step = np.int64(int(state.step) + 1)
+            state.commit()
+            inspector.record_progress(int(state.step))
+            if rank == 0:
+                with open(log_path, "a") as f:
+                    f.write(json.dumps({"epoch": epoch, "host": host,
+                                        "step": int(state.step),
+                                        "loss": loss}) + "\n")
+            if (die_host and host == die_host and epoch < die_until_epoch):
+                os.kill(os.getpid(), signal.SIGKILL)
+        return int(state.step)
+
+    final = train(state)
+    if rank == 0:
+        with open(log_path, "a") as f:
+            f.write(json.dumps({"epoch": epoch, "host": host,
+                                "done": final,
+                                "resumed_from": entry_step["v"]}) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -95,3 +95,22 @@ def test_built_probes():
     assert hvd.gloo_built() is True      # native TCP core ships built-in
     # int like the reference's version-code contract: 0 = no live TPU
     assert hvd.nccl_built() in (0, 1)
+
+
+def test_nccl_built_preinit_warns_once(caplog):
+    """ADVICE round 5: probing nccl_built() before init() silently says
+    "not built"; it must warn — exactly once — so pre-init callers know
+    the 0 is about timing, not capability."""
+    import logging
+
+    import horovod_tpu as hvd
+    from horovod_tpu import basics
+
+    hvd.shutdown()
+    basics._nccl_preinit_warned = False  # fresh process-lifetime flag
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        assert hvd.nccl_built() == 0
+        assert hvd.nccl_built() == 0
+    warnings = [r for r in caplog.records
+                if "probed before" in r.getMessage()]
+    assert len(warnings) == 1

@@ -191,6 +191,24 @@ def test_eager_adasum_duplicate_collapse(hvd, n_devices, rng):
                                atol=1e-5)
 
 
+def test_eager_adasum_rejects_noncontiguous_device_layout():
+    """ADVICE round 5: the staged eager Adasum tree silently corrupts
+    results unless device i is owned by process i // nldev (the
+    duplicate-collapse levels would pair DIFFERENT processes' values).
+    The layout gate must refuse loudly; the contiguous layout passes."""
+    import types
+
+    def dev(pidx):
+        return types.SimpleNamespace(process_index=pidx)
+
+    ok = [dev(0), dev(0), dev(1), dev(1)]
+    collective._assert_contiguous_process_layout(ok, nldev=2)
+
+    interleaved = [dev(0), dev(1), dev(0), dev(1)]
+    with pytest.raises(RuntimeError, match="contiguous nldev-aligned"):
+        collective._assert_contiguous_process_layout(interleaved, nldev=2)
+
+
 def test_alltoall_multi_axis(hvd2d, n_devices):
     """alltoall over BOTH mesh axes: the participant set is the
     linearized (dcn, data) rank order, matching mesh_rank."""

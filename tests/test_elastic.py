@@ -1,0 +1,569 @@
+"""Elastic training subsystem: discovery diffing, blacklist/backoff,
+state commit/restore/sync, worker notification, the retry loop, and the
+CPU-only worker-death -> blacklist -> re-rendezvous -> resume
+integration scenario (ISSUE 1 acceptance)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from horovod_tpu import elastic
+from horovod_tpu.elastic.discovery import (FixedHosts, HostDiscoveryPoller,
+                                           HostUpdateResult, ScriptDiscovery,
+                                           diff_hosts)
+from horovod_tpu.elastic.driver import (EXIT_RENDEZVOUS, Blacklist,
+                                        ElasticDriver)
+from horovod_tpu.elastic.exceptions import (HostsUpdatedInterrupt,
+                                            WorkerFailureError)
+from horovod_tpu.elastic.notification import (WorkerNotificationClient,
+                                              WorkerNotificationManager,
+                                              WorkerNotificationService)
+from horovod_tpu.elastic.state import JaxState, ObjectState
+from horovod_tpu.run import launcher
+from horovod_tpu.run.rendezvous import KVStoreServer
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_train_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# host discovery
+# ---------------------------------------------------------------------------
+
+def test_fixed_hosts_accepts_spec_dict_and_list():
+    assert FixedHosts("h1:4,h2").find_available_hosts_and_slots() == \
+        {"h1": 4, "h2": 1}
+    assert FixedHosts({"a": 2}).find_available_hosts_and_slots() == {"a": 2}
+    from horovod_tpu.run.allocation import HostSlots
+    fh = FixedHosts([HostSlots("x", 3)])
+    assert fh.find_available_hosts_and_slots() == {"x": 3}
+    fh.set({"y": 1})
+    assert fh.find_available_hosts_and_slots() == {"y": 1}
+
+
+def test_script_discovery(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho hostA:2\necho '# comment'\n"
+                      "echo hostB\n")
+    script.chmod(0o755)
+    d = ScriptDiscovery(str(script))
+    assert d.find_available_hosts_and_slots() == {"hostA": 2, "hostB": 1}
+
+    bad = tmp_path / "bad.sh"
+    bad.write_text("#!/bin/sh\nexit 3\n")
+    bad.chmod(0o755)
+    # a failing script reports an empty set, never crashes the poller
+    assert ScriptDiscovery(str(bad)).find_available_hosts_and_slots() == {}
+
+    malformed = tmp_path / "malformed.sh"
+    malformed.write_text("#!/bin/sh\necho hostA:2\necho 'hostB:'\n")
+    malformed.chmod(0o755)
+    # malformed output = flaky poll (same contract as a non-zero exit)
+    assert ScriptDiscovery(
+        str(malformed)).find_available_hosts_and_slots() == {}
+
+
+def test_diff_hosts():
+    old = {"a": 2, "b": 1, "c": 1}
+    new = {"a": 2, "b": 2, "d": 1}
+    added, removed, res = diff_hosts(old, new)
+    assert added == ["b", "d"]      # b grew, d is new
+    assert removed == ["c"]
+    assert res == HostUpdateResult.MIXED
+    assert diff_hosts(old, dict(old)) == ([], [], HostUpdateResult.NO_UPDATE)
+    # a shrinking host counts as removed
+    assert diff_hosts({"a": 2}, {"a": 1})[1] == ["a"]
+
+
+def test_poller_detects_membership_change():
+    fh = FixedHosts({"a": 1})
+    seen = []
+    done = threading.Event()
+
+    def on_update(added, removed, current, res):
+        seen.append((added, removed, res))
+        done.set()
+
+    poller = HostDiscoveryPoller(fh, poll_interval=0.02,
+                                 on_update=on_update)
+    poller.start()
+    try:
+        assert poller.current() == {"a": 1}
+        fh.set({"a": 1, "b": 2})
+        assert done.wait(5), "poller never reported the added host"
+    finally:
+        poller.stop()
+    assert seen[0] == (["b"], [], HostUpdateResult.ADDED)
+
+
+# ---------------------------------------------------------------------------
+# blacklist / backoff
+# ---------------------------------------------------------------------------
+
+def test_blacklist_exponential_backoff_then_permanent():
+    now = {"t": 0.0}
+    bl = Blacklist(threshold=3, base_delay=10.0, max_delay=1000.0,
+                   clock=lambda: now["t"])
+    assert not bl.excluded("h")
+
+    bl.record_failure("h")               # backoff 10s
+    assert bl.excluded("h") and not bl.blacklisted("h")
+    now["t"] = 11.0
+    assert not bl.excluded("h")          # cooled down, usable again
+
+    bl.record_failure("h")               # backoff 20s (exponential)
+    now["t"] = 25.0
+    assert bl.excluded("h")              # 11 + 20 = 31 > 25
+    now["t"] = 35.0
+    assert not bl.excluded("h")
+
+    bl.record_failure("h")               # third strike: permanent
+    now["t"] = 1e9
+    assert bl.blacklisted("h") and bl.excluded("h")
+    assert bl.hosts == {"h"}
+    assert not bl.excluded("other")
+
+
+def test_driver_waits_for_min_np_and_times_out():
+    driver = ElasticDriver(FixedHosts({"a": 1}), min_np=1,
+                           poll_interval=0.05, hopeless_grace=0.5)
+    assert driver.wait_for_available_slots(1, timeout=5) == {"a": 1}
+    driver.blacklist.record_failure("a")
+    driver.blacklist.record_failure("a")
+    driver.blacklist.record_failure("a")  # default threshold is 3
+    with pytest.raises(TimeoutError, match="blacklisted=\\['a'\\]"):
+        driver.wait_for_available_slots(1, timeout=0.3)
+    # every host permanently blacklisted -> fail fast (short grace),
+    # never burn a long start timeout on an unreachable target
+    start = time.monotonic()
+    with pytest.raises(TimeoutError):
+        driver.wait_for_available_slots(1, timeout=600)
+    assert time.monotonic() - start < 30
+    driver.stop()
+
+
+def test_driver_rejects_bad_np_bounds():
+    with pytest.raises(ValueError, match="min_np"):
+        ElasticDriver(FixedHosts({"a": 1}), min_np=0)
+    with pytest.raises(ValueError, match="max_np"):
+        ElasticDriver(FixedHosts({"a": 1}), min_np=4, max_np=2)
+
+
+# ---------------------------------------------------------------------------
+# worker notification plane
+# ---------------------------------------------------------------------------
+
+def test_notification_roundtrip_and_commit_interrupt():
+    manager = WorkerNotificationManager()
+    service = WorkerNotificationService(manager=manager, host="127.0.0.1")
+    try:
+        client = WorkerNotificationClient("127.0.0.1", service.port)
+        assert client.ping()
+        assert client.notify_hosts_updated("added")
+
+        state = ObjectState(notification_manager=manager, x=1)
+        with pytest.raises(HostsUpdatedInterrupt) as ei:
+            state.commit()
+        assert ei.value.res == "added"
+        # the interrupt drained the mailbox; progress was still saved
+        state.commit()
+        assert state.has_commit()
+    finally:
+        service.shutdown()
+
+
+def test_notification_requires_matching_key():
+    manager = WorkerNotificationManager()
+    service = WorkerNotificationService(key=b"right-key", manager=manager,
+                                        host="127.0.0.1")
+    try:
+        bad = WorkerNotificationClient("127.0.0.1", service.port,
+                                       key=b"wrong-key")
+        # server drops the bad frame; client sees a closed/empty reply
+        assert not bad.notify_hosts_updated()
+        assert manager.poll() is None
+    finally:
+        service.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# state commit / restore / sync
+# ---------------------------------------------------------------------------
+
+def test_object_state_commit_restore():
+    state = ObjectState(counter=0, blob={"k": [1, 2]})
+    state.commit()
+    state.counter = 7
+    state.blob["k"].append(3)
+    state.restore()
+    assert state.counter == 0 and state.blob == {"k": [1, 2]}
+
+
+def test_jax_state_commit_restore_sync_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    state = JaxState(directory=str(tmp_path),
+                     params={"w": jnp.ones((3,)), "b": jnp.zeros(())},
+                     step=np.int64(0))
+    state.commit()
+    state.params = {"w": jnp.full((3,), 9.0), "b": jnp.asarray(1.0)}
+    state.step = np.int64(5)
+    state.restore()
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 1.0)
+    assert int(state.step) == 0
+
+    # disk-backed: a FRESH process (new object) restores the last commit
+    state.params = {"w": jnp.full((3,), 2.0), "b": jnp.asarray(4.0)}
+    state.step = np.int64(3)
+    state.commit()
+    fresh = JaxState(directory=str(tmp_path),
+                     params={"w": jnp.zeros((3,)), "b": jnp.zeros(())},
+                     step=np.int64(0))
+    fresh.restore()
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]), 2.0)
+    assert int(fresh.step) == 3
+
+    # sync on a single process is a no-op broadcast that re-baselines
+    fresh.sync()
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]), 2.0)
+    assert int(fresh.step) == 3
+
+
+def test_jax_state_rank_gate_blocks_nonzero_rank_writes(tmp_path,
+                                                        monkeypatch):
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    state = JaxState(directory=str(tmp_path), x=np.asarray(1.0))
+    state.commit()
+    assert os.listdir(str(tmp_path)) == []  # only rank 0 writes
+
+
+# ---------------------------------------------------------------------------
+# the retry loop
+# ---------------------------------------------------------------------------
+
+def test_run_decorator_restores_on_worker_failure():
+    state = ObjectState(value=0)
+    calls = {"n": 0}
+
+    @elastic.run
+    def train(state):
+        calls["n"] += 1
+        state.value += 10
+        if calls["n"] == 1:
+            raise WorkerFailureError("peer died")  # before any commit
+        state.commit()
+        return state.value
+
+    # failure rolls back the half-applied batch: the second attempt
+    # starts from the committed (initial) value, not from 10
+    assert train(state) == 10
+    assert calls["n"] == 2
+
+
+def test_run_decorator_keeps_progress_on_hosts_updated():
+    manager = WorkerNotificationManager()
+    state = ObjectState(notification_manager=manager, step=0)
+    resets = []
+    state.register_reset_callbacks([lambda: resets.append(True)])
+
+    @elastic.run
+    def train(state):
+        while state.step < 4:
+            state.step += 1
+            if state.step == 2:
+                manager.handle_hosts_updated("added")
+            state.commit()  # raises at step 2, progress kept
+        return state.step
+
+    assert train(state) == 4
+    assert resets == [True]  # one reset, for the membership interrupt
+
+
+def test_run_decorator_reset_limit(monkeypatch):
+    monkeypatch.setenv("HOROVOD_ELASTIC_RESET_LIMIT", "2")
+    state = ObjectState(x=0)
+
+    @elastic.run
+    def train(state):
+        raise WorkerFailureError("always")
+
+    with pytest.raises(WorkerFailureError, match="giving up after 2"):
+        train(state)
+
+
+def test_elastic_train_loop_recovers_mid_run():
+    """training.py's elastic loop variant: a membership interrupt midway
+    re-syncs and finishes; committed progress is never recomputed."""
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.training import TrainState, elastic_train_loop
+
+    tx = optax.sgd(0.2)
+    params = {"w": jnp.zeros(())}
+    ts = TrainState(params=params, opt_state=tx.init(params),
+                    batch_stats={}, step=jnp.zeros((), jnp.int32))
+
+    def train_step(state, inputs, labels):
+        del inputs, labels
+        grads = {"w": 2 * (state.params["w"] - 3.0)}
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        loss = (state.params["w"] - 3.0) ** 2
+        return TrainState(params=new_params, opt_state=opt_state,
+                          batch_stats={}, step=state.step + 1), loss
+
+    manager = WorkerNotificationManager()
+    state = JaxState(notification_manager=manager, train_state=ts)
+    seen = []
+
+    def on_step(step, loss):
+        seen.append((step, loss))
+        if step == 3:
+            manager.handle_hosts_updated("removed")
+
+    final = elastic_train_loop(state, train_step,
+                               lambda step: (None, None), num_steps=6,
+                               commit_every=1, on_step=on_step)
+    assert int(final.step) == 6
+    steps = [s for s, _ in seen]
+    assert steps == [1, 2, 3, 4, 5, 6]  # no step recomputed after resync
+    losses = [l for _, l in seen]
+    assert losses == sorted(losses, reverse=True)  # monotone convergence
+
+
+# ---------------------------------------------------------------------------
+# CLI flags
+# ---------------------------------------------------------------------------
+
+def test_cli_elastic_flag_validation(tmp_path):
+    from horovod_tpu.run.run import parse_args
+
+    ok = parse_args(["--min-np", "1", "--max-np", "4", "-np", "2",
+                     "python", "t.py"])
+    assert ok.elastic and (ok.min_np, ok.max_np, ok.num_proc) == (1, 4, 2)
+    # -np defaults from --min-np in elastic mode
+    assert parse_args(["--min-np", "3", "python", "t.py"]).num_proc == 3
+
+    script = tmp_path / "d.sh"
+    script.write_text("#!/bin/sh\necho localhost:2\n")
+    script.chmod(0o755)
+    ok2 = parse_args(["--host-discovery-script", str(script),
+                      "--min-np", "2", "python", "t.py"])
+    assert ok2.elastic and ok2.num_proc == 2
+
+    def rejects(argv):
+        with pytest.raises(SystemExit):
+            parse_args(argv)
+
+    rejects(["--min-np", "4", "--max-np", "2", "python", "t.py"])
+    rejects(["--min-np", "0", "python", "t.py"])
+    rejects(["--min-np", "2", "-np", "1", "python", "t.py"])
+    rejects(["--min-np", "1", "--max-np", "2", "-np", "3",
+             "python", "t.py"])
+    rejects(["--max-np", "2", "python", "t.py"])  # no min-np, no -np
+    rejects(["--host-discovery-script", "/nonexistent-script",
+             "--min-np", "1", "python", "t.py"])
+    rejects(["--host-discovery-script", str(script), "-H", "h1:2",
+             "--min-np", "1", "python", "t.py"])
+    unexec = tmp_path / "plain.txt"
+    unexec.write_text("not a script")
+    rejects(["--host-discovery-script", str(unexec), "--min-np", "1",
+             "python", "t.py"])
+
+
+def test_nic_cache_key_and_sorted_export(monkeypatch):
+    """ADVICE round 5: the NIC pre-flight cache key must include the
+    launcher host, and fresh discovery must export sorted(common) so the
+    first and cached launches agree."""
+    import socket
+
+    from horovod_tpu.run import run as run_mod
+    from horovod_tpu.run.allocation import HostSlots
+
+    hosts = [HostSlots("b", 1), HostSlots("a", 1)]
+    key = run_mod._nic_cache_key(hosts)
+    assert socket.gethostname() in key
+    assert key.endswith("a,b")
+
+    store = {}
+
+    class FakeCache:
+        def __init__(self, *a, **k):
+            pass
+
+        def get(self, k):
+            return store.get(k)
+
+        def put(self, k, v):
+            store[k] = v
+
+    monkeypatch.setattr(run_mod.run_cache, "Cache", FakeCache)
+    args = types.SimpleNamespace(disable_cache=False, verbose=False)
+    fresh = run_mod._common_interfaces(args, hosts,
+                                       lambda: ["eth1", "eth0"])
+    assert fresh == ["eth0", "eth1"]  # sorted on the fresh path
+    cached = run_mod._common_interfaces(
+        args, hosts, lambda: pytest.fail("cache should have served this"))
+    assert cached == fresh
+
+
+def test_cli_elastic_smoke_local():
+    """hvdrun end-to-end with elastic flags: one localhost worker, one
+    epoch, clean exit."""
+    from horovod_tpu.run.run import main
+    rc = main(["--min-np", "1", "-np", "1", "--",
+               sys.executable, "-c", "print('elastic-ok')"])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# integration: worker death -> blacklist -> re-rendezvous -> resume
+# ---------------------------------------------------------------------------
+
+def _spawn_launch_fn(kv_port, worker_args, step_sleep=None):
+    """launch_fn for ElasticDriver that maps EVERY (possibly fake) host
+    to a local subprocess, with the real launcher env contract."""
+
+    def launch(slots, epoch, elastic_env):
+        job = launcher.Job()
+        for slot in slots:
+            env = dict(os.environ)
+            env.update({
+                "HOROVOD_RANK": str(slot.rank),
+                "HOROVOD_SIZE": str(slot.size),
+                "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+                "HOROVOD_HOSTNAME": slot.hostname,
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(kv_port),
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": launcher.repo_pythonpath(),
+            })
+            env.update(elastic_env)
+            if step_sleep:
+                env["HVD_ELASTIC_TEST_SLEEP"] = str(step_sleep)
+            job.procs.append(subprocess.Popen(
+                [sys.executable, WORKER] + [str(a) for a in worker_args],
+                env=env))
+        return job
+
+    return launch
+
+
+def _read_log(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_worker_death_blacklist_rerendezvous_resume(tmp_path):
+    """The acceptance scenario: rank 0's host SIGKILLs itself mid-training
+    in epochs 1 and 2 -> the driver blames and (threshold 2) blacklists
+    it -> epoch 3 re-rendezvouses on the surviving host (>= min-np=1),
+    restores the last committed JaxState from disk, and finishes. The
+    logged loss trajectory must equal an uninterrupted run's exactly."""
+    ckpt_dir = tmp_path / "ckpt"
+    log = tmp_path / "losses.jsonl"
+    num_steps = 8
+
+    kv = KVStoreServer()
+    kv_port = kv.start()
+    try:
+        driver = ElasticDriver(
+            FixedHosts({"hostA": 1, "hostB": 1}), min_np=1, max_np=2,
+            blacklist=Blacklist(threshold=2, base_delay=0.0),
+            kv=kv, poll_interval=0.2)
+        launch = _spawn_launch_fn(
+            kv_port, [ckpt_dir, log, num_steps, "hostA", 3])
+        epochs = driver.run_job(launch, max_epochs=6)
+    finally:
+        kv.stop()
+
+    assert epochs == 3
+    assert driver.blacklist.blacklisted("hostA")
+    assert not driver.blacklist.excluded("hostB")
+
+    records = _read_log(str(log))
+    done = [r for r in records if "done" in r]
+    steps = [r for r in records if "step" in r]
+    # epochs 1 and 2 each commit exactly one step on hostA before dying;
+    # epoch 3 resumes ON hostB from the last committed step
+    assert [r["host"] for r in steps[:2]] == ["hostA", "hostA"]
+    assert all(r["host"] == "hostB" for r in steps[2:])
+    assert done and done[0]["resumed_from"] == 2 and \
+        done[0]["done"] == num_steps
+
+    # loss continuity: every step computed exactly once, and the whole
+    # recovered trajectory equals the uninterrupted oracle
+    assert [r["step"] for r in steps] == list(range(1, num_steps + 1))
+    w = 0.0
+    for r in steps:
+        assert r["loss"] == pytest.approx((w - 3.0) ** 2, abs=1e-12)
+        w = w - 0.2 * 2 * (w - 3.0)
+
+    # the driver's liveness view saw the surviving worker's heartbeats
+    progress = driver.worker_progress()
+    assert 0 in progress and progress[0]["step"] == num_steps
+
+
+def test_membership_change_graceful_rerendezvous(tmp_path):
+    """A host added mid-run: the poller diffs the set, the driver posts a
+    notification, the worker drains at a commit boundary with
+    EXIT_RENDEZVOUS (no blame), and the next epoch runs on the grown
+    world from the committed step. Timeline gets MEMBERSHIP markers."""
+    from horovod_tpu.utils.timeline import Timeline
+
+    ckpt_dir = tmp_path / "ckpt"
+    log = tmp_path / "losses.jsonl"
+    tl_path = tmp_path / "timeline.json"
+    num_steps = 120  # ~5s alone: plenty of window for the interrupt
+
+    discovery = FixedHosts({"hostA": 1})
+    kv = KVStoreServer()
+    kv_port = kv.start()
+    timeline = Timeline(str(tl_path))
+    grown = threading.Event()
+
+    def grow_later():
+        # grow only once epoch 1's worker is demonstrably mid-training
+        # (first heartbeat on the KV), so the interrupt lands in-loop
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if kv.get("elastic/heartbeat/1/0") is not None:
+                break
+            time.sleep(0.1)
+        discovery.set({"hostA": 1, "hostB": 1})
+        grown.set()
+
+    try:
+        driver = ElasticDriver(discovery, min_np=1, max_np=2, kv=kv,
+                               poll_interval=0.1, timeline=timeline)
+        launch = _spawn_launch_fn(kv_port, [ckpt_dir, log, num_steps],
+                                  step_sleep=0.04)
+        threading.Thread(target=grow_later, daemon=True).start()
+        epochs = driver.run_job(launch, max_epochs=4)
+    finally:
+        kv.stop()
+        timeline.close()
+
+    assert grown.is_set()
+    assert epochs == 2, "the added host should force exactly one " \
+        "graceful re-rendezvous"
+    assert driver.blacklist.hosts == set()  # graceful exits: no blame
+
+    records = _read_log(str(log))
+    done = [r for r in records if "done" in r]
+    assert done and done[0]["done"] == num_steps
+    assert done[0]["resumed_from"] > 0, \
+        "epoch 2 must resume from committed progress, not step 0"
+    steps = [r["step"] for r in records if "step" in r]
+    assert steps == sorted(steps) and len(steps) == len(set(steps))
+
+    events = json.loads(tl_path.read_text())
+    names = {e["name"] for e in events}
+    assert "MEMBERSHIP_UPDATED" in names
+    assert "MEMBERSHIP_RENDEZVOUS" in names

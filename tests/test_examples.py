@@ -42,6 +42,21 @@ def test_synthetic_benchmark_tiny():
     assert "Img/sec per chip" in out
 
 
+def test_elastic_train_example(tmp_path):
+    """The elastic example (ISSUE 1): commit/restore under
+    @hvd.elastic.run, CPU-safe, resumes from the committed step when
+    re-run after an interruption."""
+    env = {"ELASTIC_CKPT_DIR": str(tmp_path / "ck")}
+    out = _run([sys.executable, "examples/elastic_train.py"],
+               extra_env=env, virtual_mesh=True)
+    assert "done at step 30" in out
+    # second run starts from the final committed step: no retraining
+    out2 = _run([sys.executable, "examples/elastic_train.py"],
+                extra_env=env, virtual_mesh=True)
+    assert "done at step 30" in out2
+    assert "step   1" not in out2
+
+
 def test_imagenet_resnet50_example_under_hvdrun(tmp_path):
     """The real-data flagship example (reference:
     pytorch_imagenet_resnet50.py): per-rank disjoint sharding via

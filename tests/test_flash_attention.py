@@ -43,6 +43,19 @@ def test_block_fit_non_pow2_sequences():
     assert fa._fit_block(48, 512) == 48
     assert fa._fit_block(12, 512) == 0  # not a multiple of 8
     assert fa.kernel_supported(1280, 1280, 64)
+
+
+def test_mxu_block_floor_routes_degenerate_tilings_to_fallback():
+    """ADVICE round 5: a long sequence whose only fitting block is tiny
+    (1048 = 8 * 131 -> block 8) would run an MXU-starved 8-wide kernel;
+    kernel_supported must reject it so `attention` takes the dense XLA
+    fallback. Short sequences that fit in ONE block stay on the kernel."""
+    assert fa._fit_block(1048, 512) == 8       # fits, but degenerate
+    assert not fa.kernel_supported(1048, 1048, 64)
+    assert not fa.kernel_supported(512, 1048, 64)   # either side gates
+    # whole-sequence blocks below 128 are still fine (96 = one block)
+    assert fa.kernel_supported(96, 96, 32)
+    assert fa.kernel_supported(1280, 1280, 64)      # floor met (256)
     q, k, v = _qkv(np.random.default_rng(3), s=160)  # 160 = 32*5
     out = fa.flash_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out),
