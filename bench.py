@@ -127,10 +127,102 @@ def lm_tokens_per_sec(flash, *, seq_len=2048, batch=8, layers=12,
             flops_per_step * steps / dt / 1e12)
 
 
+def _opt_state_bytes_per_device(opt_state):
+    """Measured per-device optimizer-state bytes: the bytes of every
+    state leaf's shards resident on device 0 (replicated leaves count in
+    full, ZeRO-sharded bucket rows count 1/N) — the ZeRO-1 memory claim
+    read off the real arrays, not computed from the plan."""
+    import jax as _jax
+    dev0 = _jax.local_devices()[0]
+    total = 0
+    for leaf in _jax.tree_util.tree_leaves(opt_state):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            total += np_nbytes(leaf)
+            continue
+        total += sum(s.data.nbytes for s in shards if s.device == dev0)
+    return total
+
+
+def np_nbytes(x):
+    import numpy as np
+    a = np.asarray(x)
+    return a.size * a.dtype.itemsize
+
+
+def overlap_comparison(args):
+    """``--overlap``: step time for {baseline fused-allreduce, overlapped
+    reduce-scatter pipeline, overlapped + ZeRO-1 sharded update} on the
+    same comm-heavy workload (same model, same global batch, same
+    accum_steps), plus measured per-device optimizer-state bytes. One
+    JSON line, same contract as the headline bench."""
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import training
+    from horovod_tpu.utils.benchmarks import (make_model, repeat_throughput,
+                                              synthetic_batch)
+
+    hvd.init()
+    ndev = hvd.num_devices()
+    K = args.accum_steps
+    global_batch = args.batch_size * ndev
+    images, labels = synthetic_batch(global_batch, args.image_size)
+
+    variants = {
+        "baseline_fused_ar": dict(sharded=False, overlap=False),
+        "overlap_rs": dict(sharded=False, overlap=True),
+        "overlap_rs_zero1": dict(sharded=True, overlap=True),
+    }
+    result = {"metric": f"{args.model}_overlap_pipeline_step_ms",
+              "unit": "ms/step", "accum_steps": K, "devices": ndev,
+              "per_chip_batch": args.batch_size, "repeats": args.repeats}
+    for name, kind in variants.items():
+        # adamw: momentum + second moment = the optimizer state ZeRO-1
+        # shards; a fresh model+tx per variant so donation can't alias
+        model = make_model(args.model)
+        tx = hvd.DistributedOptimizer(optax.adamw(1e-3),
+                                      sharded_update=kind["sharded"])
+        step = training.make_train_step(model, tx, donate=True,
+                                        accum_steps=K,
+                                        overlap_grads=kind["overlap"])
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0),
+                                            images[:1])
+        # run one real step to materialize the placed/donated state, then
+        # read the optimizer-state footprint off the live arrays
+        state, _ = step(state, images, labels)
+        result[f"opt_state_bytes_per_device_{name}"] = (
+            _opt_state_bytes_per_device(state.opt_state))
+        runs = repeat_throughput(step, state, images, labels,
+                                 max(args.num_warmup - 1, 0),
+                                 args.num_iters, args.repeats)
+        dts = sorted(float(r[1]) for r in runs)
+        dt = dts[len(dts) // 2]
+        result[f"step_ms_{name}"] = round(1000 * dt / args.num_iters, 2)
+        n_bound = sum(1 for r in runs
+                      if getattr(r[1], "upper_bound", False))
+        if n_bound:
+            result[f"upper_bound_windows_{name}"] = n_bound
+    base = result.get("opt_state_bytes_per_device_baseline_fused_ar", 0)
+    z1 = result.get("opt_state_bytes_per_device_overlap_rs_zero1", 0)
+    if base and z1:
+        result["zero1_opt_state_shrink_factor"] = round(base / z1, 2)
+    if result.get("step_ms_baseline_fused_ar", 0):
+        for name in ("overlap_rs", "overlap_rs_zero1"):
+            if result.get(f"step_ms_{name}"):
+                result[f"speedup_{name}_vs_baseline"] = round(
+                    result["step_ms_baseline_fused_ar"] /
+                    result[f"step_ms_{name}"], 3)
+    print(json.dumps(result))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet101",
-                        choices=["resnet50", "resnet101", "vgg16"])
+                        choices=["resnet18", "resnet50", "resnet101",
+                                 "vgg16"])
     parser.add_argument("--batch-size", type=int, default=256,
                         help="per-chip batch size (64 = literal reference "
                              "config; 256 saturates a v5e MXU)")
@@ -148,9 +240,24 @@ def main():
     parser.add_argument("--calibrate", action="store_true",
                         help="run ONLY the empirical-peak calibration and "
                              "print its JSON line")
+    parser.add_argument("--overlap", action="store_true",
+                        help="run ONLY the overlapped-exchange comparison: "
+                             "baseline fused-AR vs bucketed RS pipeline vs "
+                             "RS pipeline + ZeRO-1 (docs/PERFORMANCE.md)")
+    parser.add_argument("--accum-steps", type=int, default=4,
+                        help="gradient-accumulation microbatches for "
+                             "--overlap (the pipeline overlaps bucket k's "
+                             "reduce-scatter with microbatch k+1's "
+                             "backward)")
     args = parser.parse_args()
     if args.repeats < 1:
         parser.error("--repeats must be >= 1")
+    if args.accum_steps < 1:
+        parser.error("--accum-steps must be >= 1")
+
+    if args.overlap:
+        overlap_comparison(args)
+        return
 
     if args.calibrate:
         peak, shape = calibrate_peak_tflops()

@@ -20,6 +20,7 @@ Top-level namespace re-exports the JAX-first API (reference equivalent:
 ``horovod/tensorflow/__init__.py`` / ``horovod/torch/__init__.py``).
 """
 
+from horovod_tpu import compat  # noqa: F401  (installs jax.shard_map shim)
 from horovod_tpu.basics import (
     init,
     shutdown,
@@ -61,6 +62,7 @@ from horovod_tpu.ops.fusion import (autotune_fusion_threshold,
 from horovod_tpu.hvd_jax import (
     DistributedOptimizer,
     DistributedGradientTransform,
+    HorovodOptimizer,
     distributed_grad,
     distributed_value_and_grad,
     broadcast_variables,
@@ -86,6 +88,7 @@ __all__ = [
     "mesh_rank", "mesh_size",
     "Compression", "fused_allreduce", "autotune_fusion_threshold",
     "DistributedOptimizer", "DistributedGradientTransform",
+    "HorovodOptimizer",
     "distributed_grad", "distributed_value_and_grad",
     "broadcast_variables", "broadcast_parameters",
     "broadcast_optimizer_state", "allreduce_metrics", "join",

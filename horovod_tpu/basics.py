@@ -77,6 +77,12 @@ def init(num_slices=None, devices=None):
         cfg = Config.from_env()
         _configure_logging(cfg)
 
+        # XLA overlap flags (async collectives + latency-hiding scheduler)
+        # must be in the environment before the first backend touch, or
+        # the bucketed reduce-scatter pipeline compiles but never overlaps
+        from horovod_tpu import config as config_lib
+        config_lib.apply_xla_flags(cfg)
+
         # Multi-process: join the distributed JAX runtime so jax.devices()
         # spans every chip in the job. The coordinator address is provided by
         # the hvdrun launcher (TPU analogue of the gloo rendezvous address,
@@ -119,6 +125,11 @@ def shutdown():
             return
         from horovod_tpu.runtime import services
         services.stop(_state)
+        # a later init() may see a different device set (tests rebuild
+        # meshes; elastic re-inits after membership changes) — the eager
+        # path must not reuse a proc mesh over departed devices
+        from horovod_tpu.ops import collective
+        collective.invalidate_proc_mesh()
         _state.initialized = False
         _state.mesh = None
         _state.config = None

@@ -74,6 +74,14 @@ class Config:
     hierarchical_allgather: bool = False
     batch_d2d_memcopies: bool = True
 
+    # --- XLA overlap scheduling (bucketed reduce-scatter pipeline) ---
+    # Compiling the pipeline is only half the job: without the async-
+    # collective + latency-hiding scheduler flags XLA serializes each
+    # reduce-scatter behind the compute that precedes it and the overlap
+    # never materializes on device.
+    xla_async_collectives: bool = True
+    xla_latency_hiding_scheduler: bool = True
+
     # --- observability ---
     timeline: str = None
     timeline_mark_cycles: bool = False
@@ -115,6 +123,10 @@ class Config:
                                      DEFAULT_CYCLE_TIME_MS),
             cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY",
                                     DEFAULT_CACHE_CAPACITY),
+            xla_async_collectives=_env_bool(
+                "HOROVOD_XLA_ASYNC_COLLECTIVES", True),
+            xla_latency_hiding_scheduler=_env_bool(
+                "HOROVOD_XLA_LATENCY_HIDING_SCHEDULER", True),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
             batch_d2d_memcopies=_env_bool("HOROVOD_BATCH_D2D_MEMCOPIES", True),
@@ -139,3 +151,63 @@ class Config:
                 "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8),
             adasum_chunk_size=_env_int("HOROVOD_ADASUM_CHUNK_SIZE", 1 << 26),
         )
+
+
+def xla_overlap_flags(cfg):
+    """The libtpu/XLA flags that let the compiler actually overlap the
+    bucketed reduce-scatter pipeline with backward compute: async
+    collectives (collectives become start/done pairs other work can slide
+    between) and the latency-hiding scheduler (which does the sliding).
+    Returned as ``--flag=value`` strings for ``LIBTPU_INIT_ARGS``."""
+    flags = []
+    if cfg.xla_latency_hiding_scheduler:
+        flags.append("--xla_tpu_enable_latency_hiding_scheduler=true")
+    if cfg.xla_async_collectives:
+        flags += [
+            "--xla_tpu_enable_async_collective_fusion=true",
+            "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+            "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+            "--xla_tpu_overlap_compute_collective_tc=true",
+        ]
+    return flags
+
+
+def apply_xla_flags(cfg, env=None):
+    """Merge :func:`xla_overlap_flags` into ``LIBTPU_INIT_ARGS`` — must run
+    before the first jax backend touch (``basics.init()`` does). libtpu
+    reads the variable once at initialization; CPU/GPU builds never read
+    it, so this is a no-op off TPU. Flags the user already set (matched by
+    name) are left exactly as the user wrote them."""
+    env = os.environ if env is None else env
+    existing = env.get("LIBTPU_INIT_ARGS", "")
+    have = {f.split("=", 1)[0] for f in existing.split()}
+    add = [f for f in xla_overlap_flags(cfg)
+           if f.split("=", 1)[0] not in have]
+    if add:
+        env["LIBTPU_INIT_ARGS"] = " ".join(
+            ([existing] if existing else []) + add)
+        if _tpu_backend_already_live():
+            import logging
+            logging.getLogger("horovod_tpu").warning(
+                "hvd.init() ran AFTER the jax TPU backend was initialized "
+                "(something touched jax.devices()/arrays first): libtpu "
+                "already read LIBTPU_INIT_ARGS, so the async-collective/"
+                "latency-hiding scheduler flags were NOT picked up and the "
+                "overlapped gradient pipeline will not overlap. Call "
+                "hvd.init() before any jax work, or export the flags "
+                "yourself (docs/PERFORMANCE.md).")
+    return add
+
+
+def _tpu_backend_already_live():
+    """True when a TPU backend is already initialized in this process —
+    the point after which LIBTPU_INIT_ARGS edits are silently ignored.
+    Probes only; never initializes a backend itself."""
+    try:
+        from jax._src import xla_bridge
+        if not xla_bridge.backends_are_initialized():
+            return False
+        import jax
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:  # pragma: no cover - internal API drift
+        return False

@@ -57,6 +57,11 @@ class State:
         self._reset_callbacks.extend(callbacks)
 
     def on_reset(self):
+        # the re-rendezvous that triggered this reset may have changed
+        # the device set; a stale cached proc mesh (built from the old
+        # jax.devices()) would corrupt the next eager collective
+        from horovod_tpu.ops import collective
+        collective.invalidate_proc_mesh()
         self.reset()
         for cb in self._reset_callbacks:
             cb()

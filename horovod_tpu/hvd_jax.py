@@ -52,27 +52,117 @@ def DistributedGradientTransform(op=Average, axes=None, compression=None,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+class HorovodOptimizer:
+    """The object ``DistributedOptimizer`` returns: duck-typed as an
+    ``optax.GradientTransformation`` (``init``/``update``) and carrying the
+    reduction configuration as attributes so the training pipeline
+    (``training.make_train_step(accum_steps=..., overlap_grads=True)``) can
+    introspect it — which collective op, which axes, whether the optimizer
+    state is ZeRO-sharded, and the unwrapped inner transform for updates
+    on gradients the pipeline has already reduced."""
+
+    def __init__(self, inner, op, axes, compression, threshold_bytes,
+                 hierarchical, sharded_update, backward_passes_per_step):
+        import optax
+
+        self.inner = inner
+        self.op = op
+        self.axes = axes
+        self.compression = compression
+        self.threshold_bytes = threshold_bytes
+        self.hierarchical = hierarchical
+        self.sharded_update = sharded_update
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if sharded_update:
+            if op not in (Sum, Average):
+                raise ValueError(
+                    f"sharded_update supports Sum or Average, got {op!r}")
+            if compression is not None:
+                raise ValueError(
+                    "sharded_update does not compose with wire compression "
+                    "yet; drop one of the two")
+            if backward_passes_per_step > 1:
+                raise ValueError(
+                    "sharded_update accumulates via make_train_step("
+                    "accum_steps=...) — backward_passes_per_step>1 would "
+                    "stack a second accumulator on top")
+            self._transform = None
+            return
+        chained = optax.chain(
+            DistributedGradientTransform(
+                op=op, axes=axes, compression=compression,
+                threshold_bytes=threshold_bytes, hierarchical=hierarchical),
+            inner,
+        )
+        if backward_passes_per_step > 1:
+            chained = optax.MultiSteps(
+                chained, every_k_schedule=backward_passes_per_step)
+        self._transform = chained
+
+    def init(self, params):
+        if self.sharded_update:
+            from horovod_tpu.parallel import zero
+            plan = zero.make_plan(
+                params, op=self.op, axes=self.axes,
+                threshold_bytes=self.threshold_bytes,
+                hierarchical=bool(self._hierarchical_resolved()))
+            return zero.init(self.inner, params, plan)
+        return self._transform.init(params)
+
+    def update(self, updates, state, params=None):
+        if self.sharded_update:
+            from horovod_tpu.parallel import zero
+            if params is None:
+                raise ValueError("sharded_update needs params: "
+                                 "tx.update(grads, state, params)")
+            return zero.sharded_update(self.inner, updates, state, params)
+        return self._transform.update(updates, state, params)
+
+    def update_preaveraged(self, grads, state, params=None):
+        """Inner update on gradients that are ALREADY reduced across the
+        mesh (the overlap pipeline reduce-scatters during backward and
+        all-gathers before calling this) — skips the chained allreduce,
+        preserves the chain's state structure."""
+        if self.sharded_update or self.backward_passes_per_step > 1:
+            raise ValueError("update_preaveraged is the plain-optimizer "
+                             "tail of the overlap pipeline")
+        inner_updates, inner_state = self.inner.update(grads, state[1],
+                                                       params)
+        return inner_updates, (state[0], inner_state)
+
+    def _hierarchical_resolved(self):
+        if self.hierarchical is not None:
+            return self.hierarchical
+        from horovod_tpu import basics
+        cfg = basics._state.config
+        return cfg.hierarchical_allreduce if cfg is not None else False
+
+
 def DistributedOptimizer(tx, op=Average, axes=None, compression=None,
                          threshold_bytes=None, hierarchical=None,
-                         backward_passes_per_step=1):
+                         backward_passes_per_step=1, sharded_update=False):
     """Wrap optimizer ``tx`` so every update first averages gradients across
     all shards (the core Horovod contract,
     ``horovod/torch/__init__.py:57``). With
     ``backward_passes_per_step > 1`` gradients are accumulated locally and
     the allreduce fires every k-th step
-    (``horovod/torch/__init__.py`` backward_passes_per_step)."""
-    import optax
+    (``horovod/torch/__init__.py`` backward_passes_per_step).
 
-    chained = optax.chain(
-        DistributedGradientTransform(
-            op=op, axes=axes, compression=compression,
-            threshold_bytes=threshold_bytes, hierarchical=hierarchical),
-        tx,
-    )
-    if backward_passes_per_step > 1:
-        return optax.MultiSteps(chained,
-                                every_k_schedule=backward_passes_per_step)
-    return chained
+    ``sharded_update=True`` switches the exchange to ZeRO stage-1
+    (``parallel/zero.py``): gradients are reduce-scattered per fusion
+    bucket, ``tx`` updates only this rank's 1/N shard of its state, and the
+    updated parameter deltas are all-gathered — same wire bytes as the
+    bandwidth-optimal allreduce, ~1/N the optimizer compute and state
+    memory per device. ``tx`` must be elementwise (see the zero module
+    docstring); ``init``/``update`` must then run where the mesh axes are
+    bound (inside ``shard_map`` — ``training.make_train_step`` handles
+    placement and specs automatically)."""
+    return HorovodOptimizer(
+        tx, op=op, axes=axes, compression=compression,
+        threshold_bytes=threshold_bytes, hierarchical=hierarchical,
+        sharded_update=sharded_update,
+        backward_passes_per_step=backward_passes_per_step)
 
 
 def distributed_value_and_grad(fun, op=Average, axes=None, compression=None,

@@ -19,7 +19,7 @@ from horovod_tpu.ops.collective import (  # noqa: F401
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 from horovod_tpu.ops.fusion import fused_allreduce  # noqa: F401
 from horovod_tpu.hvd_jax import (  # noqa: F401
-    DistributedOptimizer, DistributedGradientTransform,
+    DistributedOptimizer, DistributedGradientTransform, HorovodOptimizer,
     distributed_grad, distributed_value_and_grad,
     broadcast_variables, broadcast_parameters, broadcast_optimizer_state,
     allreduce_metrics, join,

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from horovod_tpu import compat
 from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
 from horovod_tpu.parallel import mesh as mesh_lib
 
@@ -36,13 +37,10 @@ def _resolve_axes(axes):
 def _in_named_context(axes):
     """True when every axis in ``axes`` is bound (i.e. we are inside
     shard_map / a named-axis trace)."""
-    try:
-        abstract_mesh = jax.sharding.get_abstract_mesh()
-    except Exception:  # pragma: no cover - very old jax
+    bound = compat.bound_axis_names()
+    if not bound:
         return False
-    if abstract_mesh is None or abstract_mesh.empty:
-        return False
-    return all(a in abstract_mesh.axis_names for a in axes)
+    return all(a in bound for a in axes)
 
 
 def mesh_size(axes=None):
@@ -139,10 +137,17 @@ def reducescatter(x, op=Sum, axes=None):
     """Reduce across shards and scatter the result: each shard gets a
     1/size slice along dim 0. Internal building block in the reference's
     hierarchical path (``nccl_operations.cc:198-248``), exposed here as a
-    first-class op (it is the bandwidth-optimal half of an allreduce)."""
+    first-class op (it is the bandwidth-optimal half of an allreduce).
+
+    Chunk ``i`` of dim 0 lands on the shard whose ``mesh_rank(axes)`` is
+    ``i`` — the same linearized ordering every other collective uses, and
+    the inverse of :func:`allgather` (``allgather(reducescatter(x))``
+    round-trips when the reduction is a no-op)."""
     axes = _resolve_axes(axes)
     if op not in (Sum, Average):
         raise ValueError("reducescatter supports Sum or Average")
+    if not _in_named_context(axes):
+        return _eager_reducescatter(x, op, axes)
     out = x
     for a in axes:
         out = lax.psum_scatter(out, a, scatter_dimension=0, tiled=True)
@@ -202,6 +207,14 @@ def _num_processes():
 def _proc_mesh():
     devs = np.asarray(jax.devices())
     return jax.sharding.Mesh(devs.reshape(devs.size), ("proc",))
+
+
+def invalidate_proc_mesh():
+    """Drop the cached eager-path process mesh. Must be called whenever
+    the global device set can change (``basics.shutdown()``, elastic
+    re-rendezvous): a staged eager collective on a mesh built from the
+    OLD ``jax.devices()`` would address departed devices."""
+    _proc_mesh.cache_clear()
 
 
 def _stage_global(x):
@@ -305,6 +318,47 @@ def _eager_allgather(x, axes):
         return g[::nldev].reshape((-1,) + g.shape[2:])
 
     return jax.device_get(_gather(g))
+
+
+def _eager_reducescatter(x, op, axes):
+    """Eager cross-process reduce-scatter (the one collective that had no
+    eager fallback — calling it outside a named context used to die inside
+    ``lax.psum_scatter``). Same routing as its siblings: native core when
+    live, staged proc-mesh reduction otherwise, local no-op at world 1."""
+    del axes
+    core = _native_core()
+    if core is not None:
+        return jnp.asarray(core.reducescatter(np.asarray(x),
+                                              _eager_name("reducescatter"),
+                                              op=op))
+    nproc = _num_processes()
+    if nproc == 1:
+        return jnp.asarray(x)
+    x = jnp.asarray(x)
+    if x.ndim == 0:
+        raise ValueError("reducescatter needs at least 1 dimension to "
+                         "scatter over")
+    g = _stage_global(x)
+    nldev = len(jax.local_devices())
+    m = _proc_mesh()
+
+    # SPMD rule (same shape-asymmetry handling as _eager_alltoall): all
+    # processes compute the full reduction replicated, then each slices
+    # its own rows on the host. Remainder rows go to the first ranks,
+    # matching the native core's split (_core.reducescatter_async).
+    @functools.partial(
+        jax.jit, out_shardings=jax.sharding.NamedSharding(
+            m, jax.sharding.PartitionSpec()))
+    def _reduce(g):
+        s = jnp.sum(g, axis=0) / nldev  # one contribution per process
+        return s / nproc if op == Average else s
+
+    full = jax.device_get(_reduce(g))
+    me = jax.process_index()
+    base, rem = divmod(x.shape[0], nproc)
+    start = me * base + min(me, rem)
+    rows = base + (1 if me < rem else 0)
+    return jnp.asarray(full[start:start + rows])
 
 
 def _eager_alltoall(x, axes):

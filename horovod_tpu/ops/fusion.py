@@ -35,14 +35,21 @@ class _Bucket:
     shapes: tuple        # original shape per packed leaf
 
 
-def plan_buckets(leaves, threshold_bytes):
+def plan_buckets(leaves, threshold_bytes, reverse=False):
     """Greedy packing of leaves into dtype-homogeneous buckets of at most
     ``threshold_bytes`` (a single leaf larger than the threshold gets its own
     bucket, like a single tensor larger than the reference's fusion buffer,
-    ``controller.cc:687-696``)."""
+    ``controller.cc:687-696``).
+
+    ``reverse=True`` packs in REVERSE traversal order: backprop produces
+    gradients for the last layers first, so reverse-ordered buckets fill in
+    the order they become ready — the ordering the overlapped reduce-scatter
+    pipeline (``bucket_schedule``) wants, and the same trick the reference's
+    bucketed DDP implementations use (gradient hooks fire back-to-front)."""
     by_dtype = {}
-    for i, leaf in enumerate(leaves):
-        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    order = range(len(leaves) - 1, -1, -1) if reverse else range(len(leaves))
+    for i in order:
+        by_dtype.setdefault(jnp.asarray(leaves[i]).dtype, []).append(i)
     buckets = []
     for dtype, idxs in by_dtype.items():
         itemsize = np.dtype(dtype).itemsize
@@ -80,6 +87,110 @@ def _unpack(bucket, flat):
                               bucket.shapes):
         out[i] = flat[offset:offset + size].reshape(shape)
         offset += size
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Bucketed reduce-scatter pipeline.
+#
+# The overlapped gradient-exchange data plane: instead of one fused
+# allreduce after the full backward, gradients are packed into
+# reverse-traversal-ordered buckets and each bucket is reduce-scattered as
+# soon as it is ready, so the next microbatch's backward overlaps the
+# previous bucket's reduction (XLA's async-collective/latency-hiding
+# scheduler does the actual overlapping — config.xla_overlap_flags). The
+# reduced 1/world shards feed either an all-gather (plain data-parallel) or
+# a ZeRO-1 sharded optimizer update (parallel/zero.py).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSchedule:
+    """Static plan for the pipelined bucket exchange.
+
+    ``axes`` is the SCATTER ORDER: reduce-scatter walks it first-to-last,
+    all-gather inverts it, and the shard owned by a rank is chunk
+    ``collective.mesh_rank(axes)`` — so a consistent schedule is the single
+    source of truth for which rank owns which flat range (the contract
+    ``parallel/zero.py`` builds its optimizer-state partition on).
+    ``padded_sizes`` rounds each bucket up to a multiple of ``world`` so
+    XLA's equal-shard constraint holds for any parameter count."""
+
+    buckets: tuple       # _Bucket, reverse-traversal (backward-ready) order
+    padded_sizes: tuple  # per-bucket element count, multiple of world
+    world: int
+    axes: tuple
+
+    @property
+    def shard_sizes(self):
+        return tuple(p // self.world for p in self.padded_sizes)
+
+
+def bucket_schedule(leaves, world, threshold_bytes=None, axes=None,
+                    hierarchical=False):
+    """Plan the bucketed exchange for ``leaves`` (one bucket set, reused by
+    every microbatch and every step — compile once).
+
+    With ``hierarchical`` and a dcn axis present, the scatter order is
+    reordered ICI-first so the DCN stage moves ``1/ici_size`` of the bytes
+    (the two-level composition of ``parallel/hierarchical``)."""
+    from horovod_tpu import basics
+    from horovod_tpu.config import DEFAULT_FUSION_THRESHOLD
+    from horovod_tpu.parallel.mesh import DCN_AXIS
+
+    if threshold_bytes is None:
+        cfg = basics._state.config
+        threshold_bytes = (cfg.fusion_threshold if cfg is not None
+                           else DEFAULT_FUSION_THRESHOLD)
+    axes = collective._resolve_axes(axes)
+    if hierarchical and DCN_AXIS in axes and len(axes) > 1:
+        axes = tuple(a for a in axes if a != DCN_AXIS) + (DCN_AXIS,)
+    buckets = tuple(plan_buckets(leaves, threshold_bytes, reverse=True))
+    padded = tuple(sum(b.sizes) + (-sum(b.sizes)) % world for b in buckets)
+    return BucketSchedule(buckets=buckets, padded_sizes=padded,
+                          world=world, axes=axes)
+
+
+def _timeline_mark(kind, idx, nbytes):
+    """BUCKET_RS / BUCKET_AG instant markers: emitted at trace time (the
+    pipeline is compiled, so per-step device timing lives in the XLA
+    profiler; these markers document the emitted schedule next to it)."""
+    from horovod_tpu import basics
+    tl = basics._state.timeline
+    if tl is not None:
+        tl.bucket_marker(kind, idx, nbytes)
+
+
+def reduce_scatter_bucket(schedule, idx, leaves, op=collective.Average):
+    """Pack bucket ``idx`` from ``leaves``, pad to the schedule's padded
+    size, and reduce-scatter it over the schedule's scatter order. Returns
+    this rank's reduced shard (``shard_sizes[idx]`` elements)."""
+    bucket = schedule.buckets[idx]
+    flat = _pack(bucket, leaves)
+    pad = schedule.padded_sizes[idx] - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    _timeline_mark("RS", idx, flat.shape[0] * flat.dtype.itemsize)
+    return collective.reducescatter(flat, op=op, axes=schedule.axes)
+
+
+def all_gather_bucket(schedule, idx, shard):
+    """Inverse of :func:`reduce_scatter_bucket`: all-gather the per-rank
+    shards of bucket ``idx`` back into the full (padded) flat bucket.
+    ``collective.allgather`` walks the axes last-to-first, which inverts
+    the scatter order, so chunk ownership round-trips exactly."""
+    _timeline_mark("AG", idx,
+                   shard.shape[0] * schedule.world * shard.dtype.itemsize)
+    return collective.allgather(shard, axes=schedule.axes)
+
+
+def unpack_bucket(schedule, idx, flat, leaves):
+    """Scatter the flat bucket back into leaf positions: returns
+    ``{leaf_index: array}`` with each array cast to its leaf's dtype
+    (padding tail ignored)."""
+    out = {}
+    for i, arr in _unpack(schedule.buckets[idx], flat).items():
+        out[i] = arr.astype(jnp.asarray(leaves[i]).dtype)
     return out
 
 
@@ -138,6 +249,17 @@ def fused_allreduce(tree, op=collective.Average, axes=None,
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+class AutotuneTimings(dict):
+    """``{threshold_bytes: seconds}`` from :func:`autotune_fusion_threshold`
+    plus ``retried`` — how many candidate trials hit an inverted slope
+    window and were re-measured with doubled iters. A nonzero count means
+    the trial lengths were near the noise floor for this workload."""
+
+    def __init__(self, *args, retried=0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.retried = retried
+
+
 def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
                               candidates=None, trials=10, apply=True):
     """Pick the fusion bucket threshold by timed trials at init.
@@ -158,13 +280,18 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
     threads an incrementing ``salt`` operand and the evolving output
     back in as the next input (BENCH_NOTES.md, "Round-4 correction").
 
-    Returns ``(best_threshold_bytes, {threshold: seconds})``.
+    Returns ``(best_threshold_bytes, timings)`` where ``timings`` is an
+    :class:`AutotuneTimings` — ``{threshold: seconds for ``trials`` iters}``
+    whose ``retried`` attribute counts the trials that hit an inverted
+    slope window and were re-run with doubled iters (ranking candidates on
+    an inverted window's full-window upper bound would compare fixed
+    dispatch costs, not bucket plans — BENCH_r05 tail, VERDICT r5 #2).
     """
     from jax.sharding import PartitionSpec as P
 
     from horovod_tpu import basics
     from horovod_tpu.parallel import mesh as mesh_lib
-    from horovod_tpu.utils.benchmarks import slope_window, sync
+    from horovod_tpu.utils.benchmarks import WindowTime, slope_window, sync
 
     if candidates is None:
         candidates = [1 << 20, 4 << 20, 16 << 20, 64 << 20]
@@ -174,7 +301,7 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
         mesh = None
     axes_t = collective._resolve_axes(axes) if mesh is not None else axes
 
-    timings = {}
+    timings = AutotuneTimings()
     for thr in candidates:
         def f(t, salt, _thr=thr):
             # salt-shift every leaf: distinct inputs per trial call, and
@@ -202,8 +329,22 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
             out = jf(t, salt)
             return (out, salt + 1.0), out
 
-        dt, _ = slope_window(step_once, (tree, salt0 + 1.0), trials)
-        timings[thr] = dt
+        st = (tree, salt0 + 1.0)
+        dt, st = slope_window(step_once, st, trials)
+        # Inverted slope window: the trial produced a full-window UPPER
+        # BOUND (fixed dispatch costs included), not a measurement —
+        # ranking candidates on it compares noise. Retry with doubled
+        # iters until the slope carries signal (cap at 8x).
+        iters = trials
+        if dt.upper_bound:
+            timings.retried += 1
+            while dt.upper_bound and iters < trials * 8:
+                iters *= 2
+                dt, st = slope_window(step_once, st, iters)
+        # normalize retried trials back to seconds-per-`trials`-iters so
+        # candidates stay comparable under argmin
+        timings[thr] = WindowTime(float(dt) * trials / iters,
+                                  upper_bound=dt.upper_bound)
 
     # Multi-process: every rank must install the SAME winner, or ranks
     # would plan different bucket structures and emit mismatched
@@ -215,7 +356,9 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
         n = _AUTOTUNE_CALLS.setdefault("n", 0)
         _AUTOTUNE_CALLS["n"] = n + 1
         summed = _core.allreduce(vals, f"autotune.fusion.{n}", op="sum")
-        timings = {c: float(s) for c, s in zip(candidates, summed)}
+        timings = AutotuneTimings(
+            {c: float(s) for c, s in zip(candidates, summed)},
+            retried=timings.retried)
 
     best = min(timings, key=timings.get)
     if apply and basics._state.config is not None:

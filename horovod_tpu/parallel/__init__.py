@@ -16,7 +16,10 @@ from horovod_tpu.parallel.mesh import (
     DATA_AXIS,
     DCN_AXIS,
 )
-from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+from horovod_tpu.parallel.hierarchical import (hierarchical_allgather,
+                                               hierarchical_allreduce,
+                                               hierarchical_reducescatter)
+from horovod_tpu.parallel import zero
 from horovod_tpu.parallel.tensor import (
     make_tp_lm_train_step,
     shard_lm_state,
@@ -28,6 +31,7 @@ from horovod_tpu.parallel.pipeline import (pipeline_train_1f1b,
 __all__ = [
     "build_mesh", "get_mesh", "set_mesh", "data_axis_names",
     "DATA_AXIS", "DCN_AXIS", "hierarchical_allreduce",
+    "hierarchical_reducescatter", "hierarchical_allgather", "zero",
     "make_tp_lm_train_step", "shard_lm_state", "transformer_param_specs",
     "pipeline_train_1f1b", "pipelined_forward", "stack_params",
 ]

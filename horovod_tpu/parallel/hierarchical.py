@@ -17,6 +17,45 @@ from jax import lax
 from horovod_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
 
 
+def hierarchical_reducescatter(x, ici_axes=(DATA_AXIS,), dcn_axis=DCN_AXIS,
+                               op="sum"):
+    """Reduce-scatter composed ICI-first: scatter over the torus links,
+    then scatter the already-1/ici_size shard over DCN — cross-slice
+    traffic shrinks by ici_size, the same economics as
+    :func:`hierarchical_allreduce` but keeping the shard (the ZeRO-1 /
+    bucket-pipeline building block). Dim 0 must divide by the total
+    participant count (callers pad — ``ops.fusion.bucket_schedule``).
+
+    Chunk ownership is linearized ``(*ici_axes, dcn_axis)``-major, i.e.
+    ``collective.mesh_rank((*ici_axes, dcn_axis))`` — and
+    :func:`hierarchical_allgather` inverts it exactly."""
+    if op not in ("sum", "average"):
+        raise ValueError(
+            f"hierarchical_reducescatter supports sum/average, got {op!r}")
+    if isinstance(ici_axes, str):
+        ici_axes = (ici_axes,)
+    out = x
+    total = lax.axis_size(dcn_axis)
+    for a in ici_axes:
+        total *= lax.axis_size(a)
+        out = lax.psum_scatter(out, a, scatter_dimension=0, tiled=True)
+    out = lax.psum_scatter(out, dcn_axis, scatter_dimension=0, tiled=True)
+    if op == "average":
+        out = out / total
+    return out
+
+
+def hierarchical_allgather(x, ici_axes=(DATA_AXIS,), dcn_axis=DCN_AXIS):
+    """Inverse of :func:`hierarchical_reducescatter`: gather over DCN
+    first (undoing the last scatter), then over the ICI axes in reverse."""
+    if isinstance(ici_axes, str):
+        ici_axes = (ici_axes,)
+    out = lax.all_gather(x, dcn_axis, axis=0, tiled=True)
+    for a in reversed(ici_axes):
+        out = lax.all_gather(out, a, axis=0, tiled=True)
+    return out
+
+
 def hierarchical_allreduce(x, ici_axes=(DATA_AXIS,), dcn_axis=DCN_AXIS,
                            op="average"):
     """Allreduce ``x`` over ``ici_axes + (dcn_axis,)`` in three stages:

@@ -68,47 +68,112 @@ def replicated_specs(state):
     return jax.tree_util.tree_map(lambda _: P(), state)
 
 
+def state_specs(state):
+    """PartitionSpecs for a :class:`TrainState`: everything replicated,
+    except ZeRO-sharded optimizer state (``parallel/zero.ZeroState``) whose
+    bucket rows are sharded over their scatter axes — the ~1/N
+    optimizer-state memory is real, not just an algorithmic claim."""
+    from horovod_tpu.parallel import zero as zero_lib
+
+    def one(node):
+        if isinstance(node, zero_lib.ZeroState):
+            return zero_lib.state_specs(node)
+        return jax.tree_util.tree_map(lambda _: P(), node)
+
+    return jax.tree_util.tree_map(
+        one, state, is_leaf=lambda x: isinstance(x, zero_lib.ZeroState))
+
+
 def _placer(mesh, spec):
     """device_put to a stable NamedSharding (no-op when already placed).
 
-    Keeping input shardings identical across calls matters: the first call
-    sees uncommitted host arrays while later calls see outputs committed to
-    the mesh — without pinning, jit recompiles and (on jax 0.9 CPU meshes)
-    trips an XLA buffer-count mismatch."""
-    sharding = jax.sharding.NamedSharding(mesh, spec)
+    ``spec`` is a single PartitionSpec for every leaf, or a pytree of
+    specs matching the data (the ZeRO state path). Keeping input shardings
+    identical across calls matters: the first call sees uncommitted host
+    arrays while later calls see outputs committed to the mesh — without
+    pinning, jit recompiles and (on jax 0.9 CPU meshes) trips an XLA
+    buffer-count mismatch."""
+    if isinstance(spec, P):
+        sharding = jax.sharding.NamedSharding(mesh, spec)
+
+        def place(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), tree)
+
+        return place
 
     def place(tree):
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sharding), tree)
+            lambda x, s: jax.device_put(
+                x, jax.sharding.NamedSharding(mesh, s)), tree, spec)
 
     return place
 
 
 def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
-                    batch_axes=None, donate=True, dropout_seed=0):
+                    batch_axes=None, donate=True, dropout_seed=0,
+                    accum_steps=1, overlap_grads=False):
     """Build a jitted SPMD classification train step.
 
     Returns ``step(state, inputs, labels) -> (state, loss)`` where
     ``inputs``/``labels`` are global arrays whose leading (batch) dim is
-    sharded over the data axes and ``state`` is replicated. Gradients are
-    allreduced by ``tx`` (wrap with ``hvd.DistributedOptimizer``); BN stats
-    are averaged across shards (per-shard normalization like the reference,
-    one consistent stats copy for checkpointing); loss is averaged.
+    sharded over the data axes and ``state`` is replicated (ZeRO-sharded
+    optimizer state excepted). Gradients are allreduced by ``tx`` (wrap
+    with ``hvd.DistributedOptimizer``); BN stats are averaged across shards
+    (per-shard normalization like the reference, one consistent stats copy
+    for checkpointing); loss is averaged.
+
+    ``accum_steps=K`` splits each shard's batch into K equal microbatches
+    and accumulates gradients across them (one optimizer step per call —
+    the compiled analogue of ``backward_passes_per_step``, with the batch
+    presented whole). With ``overlap_grads=True`` the exchange is the
+    bucketed reduce-scatter PIPELINE: each microbatch's gradient buckets
+    (reverse-traversal order — ready-first) are reduce-scattered as soon as
+    that microbatch's backward produces them, so microbatch k+1's compute
+    overlaps bucket k's reduction inside one XLA program (the async-
+    collective scheduler flags — ``config.xla_overlap_flags`` — make the
+    overlap real on TPU). The accumulators hold 1/N-sized reduced shards
+    instead of full gradients. The shards then feed either one all-gather
+    per bucket + the inner optimizer (plain data parallelism) or the
+    ZeRO-1 sharded update (``DistributedOptimizer(sharded_update=True)``)
+    with no extra gradient all-gather at all. Numerics match the
+    ``accum_steps=1`` baseline up to reduction-order tolerance when the
+    model is microbatch-invariant (no BatchNorm across microbatches).
+    ``overlap_grads`` requires ``tx`` to be a ``DistributedOptimizer``.
     """
+    from horovod_tpu import hvd_jax
+    from horovod_tpu.ops import fusion
+    from horovod_tpu.parallel import zero as zero_lib
+
     mesh = mesh if mesh is not None else mesh_lib.get_mesh()
     data_axes = batch_axes or mesh_lib.data_axis_names(mesh)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    pipelined = overlap_grads or accum_steps > 1
+    is_hvd_tx = isinstance(tx, hvd_jax.HorovodOptimizer)
+    if pipelined:
+        if not is_hvd_tx:
+            raise ValueError(
+                "accum_steps>1 / overlap_grads=True need the optimizer "
+                "built by hvd.DistributedOptimizer(...) — the pipeline "
+                "takes over its gradient reduction")
+        if tx.backward_passes_per_step > 1:
+            raise ValueError(
+                "accum_steps and backward_passes_per_step are two "
+                "accumulators for the same thing; use accum_steps")
+    if overlap_grads and tx.compression is not None:
+        raise ValueError("overlap_grads does not compose with wire "
+                         "compression yet")
+    sharded_tx = is_hvd_tx and tx.sharded_update
+    reduce_axes = (tuple(tx.axes) if is_hvd_tx and tx.axes is not None
+                   else data_axes)
 
-    def local_step(state, inputs, labels):
-        # per-step AND per-shard dropout stream (reference semantics:
-        # each rank draws independent masks)
-        dropout_rng = jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(dropout_seed), state.step),
-            collective.mesh_rank(data_axes))
-
+    def micro_grads(state, stats, inputs, labels, dropout_rng):
+        """Loss + grads of one microbatch at fixed params."""
         def compute_loss(params):
             variables = {"params": params}
-            if state.batch_stats:
-                variables["batch_stats"] = state.batch_stats
+            if stats:
+                variables["batch_stats"] = stats
                 logits, mutated = model.apply(
                     variables, inputs, train=True, mutable=["batch_stats"],
                     rngs={"dropout": dropout_rng})
@@ -117,35 +182,121 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                                  rngs={"dropout": dropout_rng})
             return loss_fn(logits, labels), {}
 
-        (loss, new_stats), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(state.params)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        return jax.value_and_grad(compute_loss, has_aux=True)(state.params)
+
+    def local_step(state, inputs, labels):
+        # per-step AND per-shard dropout stream (reference semantics:
+        # each rank draws independent masks); each microbatch folds its
+        # index in on top
+        base_rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(dropout_seed), state.step),
+            collective.mesh_rank(data_axes))
+
+        if inputs.shape[0] % accum_steps:
+            raise ValueError(
+                f"per-shard batch {inputs.shape[0]} does not divide into "
+                f"accum_steps={accum_steps} microbatches")
+        micro = inputs.shape[0] // accum_steps
+
+        if sharded_tx:
+            # the optimizer-state partition IS the bucket schedule
+            schedule = state.opt_state.plan.schedule
+        elif overlap_grads:
+            schedule = fusion.bucket_schedule(
+                jax.tree_util.tree_leaves(state.params),
+                world=collective.mesh_size(reduce_axes),
+                threshold_bytes=tx.threshold_bytes, axes=reduce_axes,
+                hierarchical=tx._hierarchical_resolved())
+        else:
+            schedule = None
+
+        stats = state.batch_stats
+        acc_shards, acc_grads, loss_sum = None, None, 0.0
+        if pipelined:
+            for k in range(accum_steps):
+                xk = inputs[k * micro:(k + 1) * micro]
+                yk = labels[k * micro:(k + 1) * micro]
+                (loss_k, stats), grads_k = micro_grads(
+                    state, stats, xk, yk, jax.random.fold_in(base_rng, k))
+                loss_sum = loss_sum + loss_k
+                if overlap_grads:
+                    # reduce-scatter every bucket of THIS microbatch now:
+                    # the next microbatch's backward has no data
+                    # dependence on these collectives, so the latency-
+                    # hiding scheduler overlaps them (reduce-scatter is
+                    # linear — summing per-microbatch shards equals
+                    # scattering the sum)
+                    leaves_k = jax.tree_util.tree_leaves(grads_k)
+                    shards_k = [
+                        fusion.reduce_scatter_bucket(
+                            schedule, i, leaves_k,
+                            op=state.opt_state.plan.op if sharded_tx
+                            else tx.op)
+                        for i in range(len(schedule.buckets))]
+                    acc_shards = (shards_k if acc_shards is None else
+                                  [a + s for a, s in zip(acc_shards,
+                                                         shards_k)])
+                else:
+                    acc_grads = (grads_k if acc_grads is None else
+                                 jax.tree_util.tree_map(
+                                     jnp.add, acc_grads, grads_k))
+        else:
+            (loss_sum, stats), grads = micro_grads(
+                state, state.batch_stats, inputs, labels, base_rng)
+
+        inv_k = 1.0 / accum_steps
+        if overlap_grads:
+            shards = [s * jnp.asarray(inv_k, s.dtype) for s in acc_shards]
+            if sharded_tx:
+                grad_rows = {f"b{i}": s[None] for i, s in enumerate(shards)}
+                updates, opt_state = zero_lib.apply_shards(
+                    tx.inner, grad_rows, state.opt_state, state.params)
+            else:
+                leaves, treedef = jax.tree_util.tree_flatten(state.params)
+                new_leaves = [None] * len(leaves)
+                for i, s in enumerate(shards):
+                    flat = fusion.all_gather_bucket(schedule, i, s)
+                    for j, arr in fusion.unpack_bucket(
+                            schedule, i, flat, leaves).items():
+                        new_leaves[j] = arr
+                grads = jax.tree_util.tree_unflatten(treedef, new_leaves)
+                updates, opt_state = tx.update_preaveraged(
+                    grads, state.opt_state, state.params)
+        else:
+            if pipelined:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * jnp.asarray(inv_k, g.dtype), acc_grads)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+
         params = optax.apply_updates(state.params, updates)
-        if new_stats:
-            new_stats = jax.tree_util.tree_map(
+        if stats:
+            stats = jax.tree_util.tree_map(
                 lambda x: collective.allreduce(x, op=collective.Average,
-                                               axes=data_axes), new_stats)
-        loss = collective.allreduce(loss, op=collective.Average,
-                                    axes=data_axes)
+                                               axes=data_axes), stats)
+        loss = collective.allreduce(loss_sum * inv_k,
+                                    op=collective.Average, axes=data_axes)
         new_state = TrainState(params=params, opt_state=opt_state,
-                               batch_stats=new_stats, step=state.step + 1)
+                               batch_stats=stats, step=state.step + 1)
         return new_state, loss
 
     def outer(state, inputs, labels):
-        state_specs = replicated_specs(state)
+        specs = state_specs(state)
         sharded = jax.shard_map(
             local_step, mesh=mesh,
-            in_specs=(state_specs, P(data_axes), P(data_axes)),
-            out_specs=(state_specs, P()),
+            in_specs=(specs, P(data_axes), P(data_axes)),
+            out_specs=(specs, P()),
             check_vma=False)
         return sharded(state, inputs, labels)
 
     jitted = jax.jit(outer, donate_argnums=(0,) if donate else ())
-    place_repl = _placer(mesh, P())
     place_data = _placer(mesh, P(data_axes))
 
+    def place_state(state):
+        return _placer(mesh, state_specs(state))(state)
+
     def step(state, inputs, labels):
-        return jitted(place_repl(state), place_data(inputs),
+        return jitted(place_state(state), place_data(inputs),
                       place_data(labels))
 
     step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
@@ -154,7 +305,7 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
         """AOT lower with the SAME placement the executed path uses, so
         the compile cache is shared and cost_analysis describes the
         module that actually runs."""
-        return jitted.lower(place_repl(state), place_data(inputs),
+        return jitted.lower(place_state(state), place_data(inputs),
                             place_data(labels))
 
     step.lower = lower
@@ -259,20 +410,22 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
     token_spec = P(batch_axis, seq_axis) if seq_axis else P(batch_axis)
 
     def outer(state, tokens):
-        state_specs = replicated_specs(state)
+        specs = state_specs(state)
         sharded = jax.shard_map(
             local_step, mesh=mesh,
-            in_specs=(state_specs, token_spec),
-            out_specs=(state_specs, P()),
+            in_specs=(specs, token_spec),
+            out_specs=(specs, P()),
             check_vma=False)
         return sharded(state, tokens)
 
     jitted = jax.jit(outer, donate_argnums=(0,) if donate else ())
-    place_repl = _placer(mesh, P())
     place_tokens = _placer(mesh, token_spec)
 
+    def place_state(state):
+        return _placer(mesh, state_specs(state))(state)
+
     def step(state, tokens):
-        return jitted(place_repl(state), place_tokens(tokens))
+        return jitted(place_state(state), place_tokens(tokens))
 
     step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
 
@@ -280,7 +433,7 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
         """AOT lower with the SAME placement the executed path uses (one
         shared compile-cache entry; cost_analysis describes the module
         that actually runs)."""
-        return jitted.lower(place_repl(state), place_tokens(tokens))
+        return jitted.lower(place_state(state), place_tokens(tokens))
 
     step.lower = lower
     return step

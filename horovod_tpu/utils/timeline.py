@@ -74,6 +74,15 @@ class Timeline:
         if self._mark_cycles:
             self.instant(f"CYCLE_{n}")
 
+    def bucket_marker(self, kind, index, nbytes):
+        """BUCKET_RS / BUCKET_AG markers from the overlapped gradient-
+        exchange pipeline (``ops.fusion``): emitted at trace time (the
+        schedule is compiled once), they document which buckets exist and
+        their wire bytes so the XLA profiler's device trace can be read
+        against the emitted schedule."""
+        self.instant(f"BUCKET_{kind}", args={"bucket": index,
+                                             "bytes": int(nbytes)})
+
     def membership(self, event, details=None):
         """Instant marker for an elastic-membership change (host set
         updated, rendezvous epoch opened, worker failure blamed) so

@@ -17,6 +17,16 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache, shared BY INHERITANCE with every
+# subprocess the suite spawns (elastic workers, hvdrun example runs, the
+# dryrun's virtual-mesh subprocess): those re-compile the same small
+# models over and over, and with the whole suite actually exercising the
+# compiled data plane the repeated compiles dominate suite wall-time.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/horovod_tpu_test_xla_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
 import jax  # noqa: E402
 
 # The axon sitecustomize may already have forced jax_platforms=axon,cpu;

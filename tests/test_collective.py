@@ -105,6 +105,50 @@ def test_reducescatter(hvd, n_devices):
     np.testing.assert_allclose(np.asarray(out), expected)
 
 
+def test_reducescatter_average(hvd, n_devices):
+    def f():
+        r = collective.mesh_rank().astype(jnp.float32)
+        x = (r + 1.0) * jnp.ones((n_devices,))
+        return collective.reducescatter(x, op=hvd_api.Average)
+
+    out = shard_apply(hvd, f, out_specs=P("data"))
+    expected = np.mean(np.arange(1, n_devices + 1))
+    np.testing.assert_allclose(np.asarray(out),
+                               expected * np.ones(n_devices), rtol=1e-6)
+
+
+def test_reducescatter_eager_fallback_single_process(hvd):
+    """The eager fallback (it was the ONLY collective without one — calling
+    it at top level used to die inside lax.psum_scatter): one launched
+    process => world size 1 => the whole reduced array, like its
+    siblings."""
+    x = np.arange(8.0, dtype=np.float32).reshape(4, 2)
+    out = hvd.reducescatter(x)
+    np.testing.assert_allclose(np.asarray(out), x)
+    out = hvd.reducescatter(x, op=hvd_api.Average)
+    np.testing.assert_allclose(np.asarray(out), x)
+    with pytest.raises(ValueError, match="Sum or Average"):
+        hvd.reducescatter(x, op=hvd_api.Min)
+
+
+def test_proc_mesh_invalidated_on_shutdown():
+    """Elastic re-rendezvous / re-init must not reuse an eager proc mesh
+    built from the previous device set (stale jax.devices())."""
+    import horovod_tpu as hvd_mod
+    hvd_mod.shutdown()
+    hvd_mod.init()
+    collective._proc_mesh()
+    assert collective._proc_mesh.cache_info().currsize == 1
+    hvd_mod.shutdown()  # must drop the cache (device set may change)
+    assert collective._proc_mesh.cache_info().currsize == 0
+    # the elastic reset path clears it too
+    from horovod_tpu.elastic.state import ObjectState
+    collective._proc_mesh()
+    assert collective._proc_mesh.cache_info().currsize == 1
+    ObjectState(value=0).on_reset()
+    assert collective._proc_mesh.cache_info().currsize == 0
+
+
 def test_alltoall(hvd, n_devices):
     def f():
         me = collective.mesh_rank().astype(jnp.float32)

@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 
 
@@ -33,6 +35,7 @@ def test_pytorch_mnist_example_under_hvdrun():
                 sys.executable, "examples/pytorch_mnist.py"])
 
 
+@pytest.mark.slow  # ~80 s CPU: full slope-window bench subprocess
 def test_synthetic_benchmark_tiny():
     out = _run([sys.executable, "examples/jax_synthetic_benchmark.py",
                 "--model", "resnet18", "--batch-size", "2",
@@ -117,6 +120,7 @@ def test_lm_moe_example():
     assert "done" in out
 
 
+@pytest.mark.slow  # ~80 s CPU: weak-scaling sweep subprocess
 def test_scaling_harness_tiny():
     out = _run([sys.executable, "bench_scaling.py", "--model", "resnet18",
                 "--batch-size", "2", "--image-size", "32",

@@ -4,6 +4,7 @@ FuseResponses + fused allreduce value checks in test_tensorflow.py)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd_api
@@ -28,6 +29,64 @@ def test_plan_buckets_respects_threshold():
     # a single oversized leaf still gets a bucket
     big = [np.ones((1000,), np.float32)]
     assert len(fusion.plan_buckets(big, threshold_bytes=100)) == 1
+
+
+def test_plan_buckets_reverse_traversal_order():
+    """reverse=True packs back-to-front: backprop readiness order (the
+    bucket the last layer's grads land in comes first)."""
+    leaves = [np.ones((4,), np.float32), np.ones((8,), np.float32),
+              np.ones((2,), np.float32)]
+    buckets = fusion.plan_buckets(leaves, threshold_bytes=16, reverse=True)
+    assert [b.leaf_indices for b in buckets] == [(2,), (1,), (0,)]
+    # forward order for contrast
+    fwd = fusion.plan_buckets(leaves, threshold_bytes=16)
+    assert fwd[0].leaf_indices[0] == 0
+
+
+def test_bucket_schedule_pads_to_world():
+    leaves = [np.ones((5,), np.float32), np.ones((6,), np.float32)]
+    sched = fusion.bucket_schedule(leaves, world=8, threshold_bytes=1 << 20,
+                                   axes=("data",))
+    assert len(sched.buckets) == 1
+    assert sched.padded_sizes == (16,)  # 11 -> 16 (multiple of 8)
+    assert sched.shard_sizes == (2,)
+    assert sched.axes == ("data",)
+
+
+def test_bucket_schedule_hierarchical_reorders_ici_first():
+    leaves = [np.ones((8,), np.float32)]
+    sched = fusion.bucket_schedule(leaves, world=8, threshold_bytes=1 << 20,
+                                   axes=("dcn", "data"), hierarchical=True)
+    assert sched.axes == ("data", "dcn")  # DCN stage moves 1/ici the bytes
+
+
+def test_bucket_rs_ag_roundtrip_matches_fused_allreduce(hvd, n_devices):
+    """reduce_scatter_bucket + all_gather_bucket + unpack == the fused
+    allreduce of the same tree (the pipeline's exchange is the same
+    reduction, split at the shard boundary)."""
+    tree_template = [np.ones((5,), np.float32), np.ones((3, 2), np.float32)]
+
+    def f():
+        r = collective.mesh_rank().astype(jnp.float32)
+        leaves = [(r + 1) * jnp.ones((5,)), (r + 2) * jnp.ones((3, 2))]
+        sched = fusion.bucket_schedule(leaves, world=n_devices,
+                                       threshold_bytes=1 << 20)
+        out = [None, None]
+        for i in range(len(sched.buckets)):
+            shard = fusion.reduce_scatter_bucket(sched, i, leaves,
+                                                 op=hvd_api.Average)
+            flat = fusion.all_gather_bucket(sched, i, shard)
+            for j, arr in fusion.unpack_bucket(sched, i, flat,
+                                               leaves).items():
+                out[j] = arr
+        ref = fusion.fused_allreduce(list(leaves), op=hvd_api.Average)
+        return out, ref
+
+    specs = [P() for _ in tree_template]
+    out, ref = jax.shard_map(f, mesh=hvd.mesh(), in_specs=(),
+                             out_specs=(specs, specs), check_vma=False)()
+    for o, e in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(e), rtol=1e-6)
 
 
 def test_fused_allreduce_matches_unfused(hvd, n_devices):
@@ -113,6 +172,41 @@ def test_fused_allreduce_hierarchical_on_2d_mesh(hvd2d, n_devices):
     np.testing.assert_allclose(out["w"], expected * np.ones((9,)), rtol=1e-6)
 
 
+def test_hierarchical_rs_ag_pin_the_schedule_contract(hvd2d, n_devices):
+    """parallel.hierarchical_reducescatter/allgather and the bucket
+    schedule's reordered-axes composition (collective.reducescatter/
+    allgather over ('data','dcn')) are two spellings of ONE chunk-
+    ownership contract — rank mesh_rank(('data','dcn')) owns chunk r.
+    Pinned here so they cannot drift apart: the ICI-first DCN-bytes
+    economics in docs/PERFORMANCE.md assumes they agree."""
+    from horovod_tpu.parallel import hierarchical as hier
+
+    def f():
+        r = collective.mesh_rank(("data", "dcn")).astype(jnp.float32)
+        x = (r + 1.0) * (jnp.arange(n_devices * 2, dtype=jnp.float32) + 1.0)
+        a = hier.hierarchical_reducescatter(x, ici_axes=("data",),
+                                            dcn_axis="dcn", op="average")
+        b = collective.reducescatter(x, op=hvd_api.Average,
+                                     axes=("data", "dcn"))
+        ga = hier.hierarchical_allgather(a, ici_axes=("data",),
+                                         dcn_axis="dcn")
+        gb = collective.allgather(b, axes=("data", "dcn"))
+        return a, b, ga, gb
+
+    shard_spec = P(("data", "dcn"))
+    a, b, ga, gb = jax.shard_map(
+        f, mesh=hvd2d.mesh(), in_specs=(),
+        out_specs=(shard_spec, shard_spec, P(), P()), check_vma=False)()
+    # position-dependent payload: the full reduction is mean(r+1)*(i+1),
+    # so both the values AND the chunk ownership must agree
+    expected = (np.mean(np.arange(1, n_devices + 1))
+                * (np.arange(n_devices * 2) + 1.0))
+    np.testing.assert_allclose(np.asarray(a), expected, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ga), expected, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), rtol=1e-6)
+
+
 def test_fused_allreduce_hierarchical_adasum(hvd2d, n_devices, rng):
     """DistributedOptimizer(op=Adasum, hierarchical=True) semantics: the
     fused hierarchical branch must run the 2-level Adasum COMPOSITE
@@ -192,9 +286,11 @@ def test_autotune_uses_shared_timing_primitive(hvd, monkeypatch):
 
     def spying(step_once, state, iters, base_iters=2):
         calls["n"] += 1
+        seen = []
+        calls["salts"].append(seen)
 
         def spy_step(st):
-            calls["salts"].append(float(st[1]))
+            seen.append(float(st[1]))
             return step_once(st)
 
         return real(spy_step, state, iters, base_iters=base_iters)
@@ -203,12 +299,42 @@ def test_autotune_uses_shared_timing_primitive(hvd, monkeypatch):
     tree = {"a": jnp.ones((64,))}
     fusion.autotune_fusion_threshold(tree, candidates=[1 << 10, 1 << 20],
                                      trials=2, apply=False)
-    assert calls["n"] == 2  # one slope window per candidate
-    # every trial call saw a distinct salt (fresh inputs, no memoization)
-    per_candidate = len(calls["salts"]) // 2
-    for i in range(2):
-        salts = calls["salts"][i * per_candidate:(i + 1) * per_candidate]
-        assert len(set(salts)) == len(salts)
+    # at least one slope window per candidate (inverted-window retries —
+    # common for these noise-floor-sized trials — may add more)
+    assert calls["n"] >= 2
+    # every trial call within a window saw a distinct salt (fresh inputs,
+    # no memoization)
+    for seen in calls["salts"]:
+        assert len(set(seen)) == len(seen)
+
+
+def test_autotune_retries_inverted_windows(hvd, monkeypatch):
+    """An inverted slope window is an upper BOUND, not a measurement:
+    the autotuner must re-run the trial with doubled iters instead of
+    ranking candidates on it, and surface the retry count on the
+    returned timings (VERDICT r5 #2)."""
+    from horovod_tpu.utils import benchmarks
+
+    seen = {"iters": []}
+
+    def fake(step_once, state, iters, base_iters=2):
+        seen["iters"].append(iters)
+        # every first (trials-length) window inverts; doubled retries land
+        return benchmarks.WindowTime(0.1 * iters,
+                                     upper_bound=(iters == 2)), state
+
+    monkeypatch.setattr(benchmarks, "slope_window", fake)
+    tree = {"a": jnp.ones((64,))}
+    best, timings = fusion.autotune_fusion_threshold(
+        tree, candidates=[1 << 10, 1 << 20], trials=2, apply=False)
+    assert timings.retried == 2  # both candidates hit the inversion
+    # retries doubled the iters
+    assert seen["iters"] == [2, 4, 2, 4]
+    # and the recorded values are normalized back to per-`trials` cost,
+    # unflagged (the retry measured cleanly)
+    for v in timings.values():
+        assert not getattr(v, "upper_bound", False)
+        assert v == pytest.approx(0.1 * 2)
 
 
 def test_no_block_until_ready_in_package():
@@ -243,4 +369,9 @@ def test_one_collective_per_bucket(hvd):
     fn = jax.jit(jax.shard_map(f, mesh=hvd.mesh(), in_specs=(),
                                out_specs=[P()] * 10, check_vma=False))
     hlo = fn.lower().compile().as_text()
-    assert hlo.count("all-reduce") <= 2  # one bucket (plus possible fusion)
+    # count all-reduce instruction DEFINITIONS (an op's result is
+    # referenced by every consumer line, so a substring count scales with
+    # the number of unpacked leaves, not collectives)
+    import re
+    defs = re.findall(r"= \S+ all-reduce(?:-start)?\(", hlo)
+    assert len(defs) <= 2  # one bucket (plus possible fusion)
