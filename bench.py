@@ -215,7 +215,20 @@ def overlap_comparison(args):
                 result[f"speedup_{name}_vs_baseline"] = round(
                     result["step_ms_baseline_fused_ar"] /
                     result[f"step_ms_{name}"], 3)
+    result["telemetry"] = _telemetry_block()
     print(json.dumps(result))
+
+
+def _telemetry_block():
+    """The registry snapshot for the BENCH json: collective bytes and
+    bucket fill ride alongside throughput, so perf rounds can attribute
+    a regression to wire volume / bucket structure without rerunning."""
+    from horovod_tpu import telemetry
+    snap = telemetry.get_registry().snapshot()
+    keep = ("horovod_collective", "horovod_bucket", "horovod_step",
+            "horovod_examples", "horovod_compile")
+    return {k: v for k, v in sorted(snap.items())
+            if k.startswith(keep)}
 
 
 def main():
@@ -408,6 +421,7 @@ def main():
         result["autotuned_fusion_threshold_mb"] = autotuned_mb
     if autotune_error is not None:
         result["autotune_error"] = autotune_error
+    result["telemetry"] = _telemetry_block()
     print(json.dumps(result))
 
 

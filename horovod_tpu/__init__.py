@@ -74,6 +74,7 @@ from horovod_tpu.hvd_jax import (
 from horovod_tpu import checkpoint
 from horovod_tpu import data
 from horovod_tpu import elastic
+from horovod_tpu import telemetry
 
 __version__ = "0.1.0"
 
@@ -92,5 +93,5 @@ __all__ = [
     "distributed_grad", "distributed_value_and_grad",
     "broadcast_variables", "broadcast_parameters",
     "broadcast_optimizer_state", "allreduce_metrics", "join",
-    "checkpoint", "data", "elastic",
+    "checkpoint", "data", "elastic", "telemetry",
 ]

@@ -46,6 +46,7 @@ class _State:
         self.controller = None  # host-side controller client (set when used)
         self.timeline = None
         self.stall_inspector = None
+        self.metrics_server = None
         self.joined = False
 
 

@@ -62,17 +62,27 @@ class BroadcastGlobalVariablesCallback(Callback):
 
 class MetricAverageCallback(Callback):
     """Average epoch metrics over all ranks (reference
-    ``_keras/callbacks.py:46-85``)."""
+    ``_keras/callbacks.py:46-85``).
+
+    Delegates to ``hvd.allreduce_metrics`` so nested metric pytrees and
+    non-numeric values (which pass through unchanged) behave identically
+    on both surfaces; numeric leaves come back as Python floats like the
+    reference callback writes back into ``logs``."""
 
     def on_epoch_end(self, epoch, metrics=None, ctx=None):
         if not metrics:
             return metrics
-        from horovod_tpu.ops import collective
-        return {
-            k: float(np.asarray(collective.allreduce(
-                np.asarray(v, dtype=np.float32), op=collective.Average)))
-            for k, v in metrics.items()
-        }
+        from horovod_tpu import hvd_jax
+
+        reduced = hvd_jax.allreduce_metrics(metrics)
+
+        def _to_float(x):
+            return (float(np.asarray(x))
+                    if hasattr(x, "dtype") or isinstance(x, (int, float))
+                    else x)
+
+        import jax
+        return jax.tree_util.tree_map(_to_float, reduced)
 
 
 def _set_lr(optimizer, lr):

@@ -87,6 +87,13 @@ class Config:
     timeline_mark_cycles: bool = False
     log_level: str = "warning"
     log_hide_timestamp: bool = False
+    # metrics endpoint (telemetry/server.py): None = disabled, 0 = bind
+    # an ephemeral port. The launcher assigns base_port + local_rank per
+    # rank (run/launcher.py). Loopback by default — the endpoints are
+    # unauthenticated (security note in docs/OBSERVABILITY.md).
+    metrics_port: int = None
+    metrics_addr: str = "127.0.0.1"
+    profile_dir: str = None
 
     # --- stall inspector (stall_inspector.h:30-70) ---
     stall_check_disable: bool = False
@@ -132,6 +139,9 @@ class Config:
             batch_d2d_memcopies=_env_bool("HOROVOD_BATCH_D2D_MEMCOPIES", True),
             timeline=_env_str("HOROVOD_TIMELINE"),
             timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+            metrics_port=_env_int("HOROVOD_METRICS_PORT", None),
+            metrics_addr=_env_str("HOROVOD_METRICS_ADDR", "127.0.0.1"),
+            profile_dir=_env_str("HOROVOD_PROFILE_DIR"),
             log_level=_env_str("HOROVOD_LOG_LEVEL", "warning"),
             log_hide_timestamp=_env_bool("HOROVOD_LOG_HIDE_TIME"),
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),

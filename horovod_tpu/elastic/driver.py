@@ -30,8 +30,14 @@ import time
 from horovod_tpu.elastic.discovery import HostDiscoveryPoller
 from horovod_tpu.elastic.notification import WorkerNotificationClient
 from horovod_tpu.run import allocation
+from horovod_tpu.telemetry import get_registry
+from horovod_tpu.telemetry import instruments as _tele
 
 logger = logging.getLogger("horovod_tpu")
+
+# a worker whose median step time exceeds the cluster median by this
+# factor gets flagged as a straggler in the driver's cluster view
+STRAGGLER_THRESHOLD = 2.0
 
 # Worker exit code meaning "re-rendezvous requested" (EX_TEMPFAIL): the
 # elastic loop exits with it on HostsUpdatedInterrupt under a driver, so
@@ -122,9 +128,24 @@ class ElasticDriver:
         self.epoch = 0
         self._current_slots = []
         self._membership_dirty = False
+        self._flagged_stragglers = set()
         self._poller = HostDiscoveryPoller(
             discovery, poll_interval=poll_interval,
             on_update=self._on_hosts_updated)
+        # launcher-side telemetry (the driver has its own registry view;
+        # worker metrics arrive through the KV heartbeats)
+        reg = get_registry()
+        self._m_epochs = reg.counter(
+            _tele.RENDEZVOUS_EPOCHS, "Rendezvous epochs opened")
+        self._m_blacklist = reg.gauge(
+            _tele.BLACKLIST_HOSTS, "Hosts currently excluded "
+            "(blacklisted or in a backoff window)")
+        self._m_recovery = reg.histogram(
+            _tele.RECOVERY_SECONDS, "Wall time from a worker failure to "
+            "the next completed rendezvous")
+        self._m_straggler = reg.gauge(
+            _tele.STRAGGLER_RATIO, "Slowest/median per-rank median step "
+            "time across the current epoch's workers")
 
     # -- membership ----------------------------------------------------------
     def available_hosts(self):
@@ -254,6 +275,50 @@ class ElasticDriver:
                 progress[slot.rank] = json.loads(raw)
         return progress
 
+    def cluster_view(self):
+        """Aggregate the metric snapshots riding the KV heartbeats into
+        the coordinator's view of the epoch: per-rank step progress and
+        step-time medians, the slowest/median step-time ratio, and the
+        flagged straggler ranks (ratio > ``STRAGGLER_THRESHOLD``).
+        Updates the ``horovod_straggler_step_time_ratio`` gauge and logs
+        flagged ranks (rate-limited to once per epoch per rank)."""
+        progress = self.worker_progress()
+        view = {"epoch": self.epoch, "ranks": {}, "stragglers": [],
+                "straggler_ratio": None}
+        step_times = {}
+        for rank, hb in progress.items():
+            m = hb.get("metrics") or {}
+            view["ranks"][rank] = {
+                "step": hb.get("step"), "last_heartbeat": hb.get("time"),
+                **m}
+            t = m.get("step_seconds_p50")
+            if t:
+                step_times[rank] = float(t)
+        if len(step_times) >= 2:
+            ordered = sorted(step_times.values())
+            # LOWER median: with the upper-middle element, a 2-worker
+            # cluster's "median" would be its own slowest rank and a 10x
+            # straggler could never be flagged
+            median = ordered[(len(ordered) - 1) // 2]
+            slowest = ordered[-1]
+            if median > 0:
+                ratio = slowest / median
+                view["straggler_ratio"] = ratio
+                self._m_straggler.set(ratio)
+                view["stragglers"] = sorted(
+                    r for r, t in step_times.items()
+                    if t / median > STRAGGLER_THRESHOLD)
+        fresh = [r for r in view["stragglers"]
+                 if r not in self._flagged_stragglers]
+        if fresh:
+            self._flagged_stragglers.update(fresh)
+            logger.warning(
+                "elastic: epoch %d straggler(s) %s — median step time "
+                ">%.1fx the cluster median (%s)", self.epoch, fresh,
+                STRAGGLER_THRESHOLD,
+                {r: round(step_times[r], 4) for r in fresh})
+        return view
+
     # -- rendezvous ----------------------------------------------------------
     def rendezvous(self):
         """Open a new epoch: wait for min-np capacity, assign ranks to
@@ -267,6 +332,11 @@ class ElasticDriver:
         self.epoch += 1
         slots = allocation.allocate(host_list, np_now)
         self._current_slots = slots
+        self._flagged_stragglers = set()
+        self._m_epochs.inc()
+        self._m_blacklist.set(sum(
+            1 for h in self._poller.current()
+            if self.blacklist.excluded(h)))
         if self._kv is not None:
             # stale cross-epoch coordination keys must not leak into the
             # new world (a late rank would adopt epoch N-1's controller)
@@ -303,12 +373,25 @@ class ElasticDriver:
         ``first_failure``). Returns the number of epochs used."""
         self._poller.start()
         spurious_drains = 0
+        failure_time = None
+        monitor_stop = threading.Event()
+        monitor = threading.Thread(
+            target=self._monitor_cluster, args=(monitor_stop,),
+            name="hvd_tpu_elastic_cluster", daemon=True)
+        monitor.start()
         try:
             while True:
                 if max_epochs is not None and self.epoch >= max_epochs:
                     raise RuntimeError(
                         f"elastic: giving up after {self.epoch} epochs")
                 slots = self.rendezvous()
+                if failure_time is not None:
+                    # failure -> blame -> wait-for-slots -> new epoch
+                    # published: the recovery wall-time the north-star
+                    # cares about
+                    self._m_recovery.observe(
+                        time.monotonic() - failure_time)
+                    failure_time = None
                 job = launch_fn(slots, self.epoch, self.worker_env())
                 job.join()
                 first = job.first_failure
@@ -340,6 +423,7 @@ class ElasticDriver:
                                 "re-rendezvous", self.epoch)
                     continue
                 spurious_drains = 0
+                failure_time = time.monotonic()
                 host = slots[rank].hostname
                 logger.warning(
                     "elastic: epoch %d rank %d on %s exited with %s "
@@ -350,7 +434,20 @@ class ElasticDriver:
                     "FAILURE", {"epoch": self.epoch, "rank": rank,
                                 "host": host, "exit_code": rc})
         finally:
+            monitor_stop.set()
             self._poller.stop()
+
+    def _monitor_cluster(self, stop_event, interval=None):
+        """Background cluster-view refresh while a job runs: keeps the
+        straggler gauge current and the flag log timely (run_job itself
+        is blocked in ``job.join()``)."""
+        interval = interval if interval is not None else max(
+            2.0, 5 * self._poll_interval)
+        while not stop_event.wait(interval):
+            try:
+                self.cluster_view()
+            except Exception:
+                logger.debug("cluster view refresh failed", exc_info=True)
 
     def stop(self):
         self._poller.stop()

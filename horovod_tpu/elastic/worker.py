@@ -87,10 +87,20 @@ class WorkerContext:
     def report_progress(self, step=None):
         """Publish a heartbeat; wired into ``StallInspector.
         record_progress`` via :func:`attach_progress_reporter` so every
-        completed step refreshes the driver's liveness view."""
+        completed step refreshes the driver's liveness view. The
+        heartbeat carries a compact metrics snapshot
+        (``telemetry.instruments.kv_snapshot``) so the driver can render
+        a cluster view and flag stragglers without any new channel."""
         if not self._kv_ready():
             return
         payload = {"step": step, "time": time.time()}
+        try:
+            from horovod_tpu.telemetry import instruments as _tele
+            metrics = _tele.kv_snapshot()
+            if metrics:
+                payload["metrics"] = metrics
+        except Exception:
+            pass  # telemetry must never break the liveness channel
         try:
             kv_put(self._kv_addr, self._kv_port,
                    f"elastic/heartbeat/{self.epoch}/{self.rank}",

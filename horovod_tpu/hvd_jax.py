@@ -223,8 +223,27 @@ def allreduce_metrics(metrics, axes=None, op=Average):
     ``op=Average`` (default) matches the reference: every metric becomes
     an fp32 mean — including int-valued ones (a sample COUNT averaged
     across shards is a float). Pass ``op=Sum`` for totals: integer
-    leaves then keep their dtype (int counts stay exact ints)."""
+    leaves then keep their dtype (int counts stay exact ints).
+
+    ``metrics`` may be any pytree (nested dicts of a framework's logs
+    included); non-numeric leaves (strings, ``None``) pass through
+    unchanged — the reference iterates ``logs`` items and only ever sees
+    numeric metric values, so reducing a string has no reference
+    semantics to honor and dropping it would lose the user's data.
+    An empty dict/pytree comes back as-is."""
+    def _numeric(x):
+        if isinstance(x, (bool, int, float)) or (
+                hasattr(x, "dtype") and hasattr(x, "shape")):
+            try:
+                return jnp.issubdtype(jnp.result_type(x), jnp.number) or \
+                    jnp.issubdtype(jnp.result_type(x), jnp.bool_)
+            except Exception:
+                return False
+        return False
+
     def one(x):
+        if not _numeric(x):
+            return x
         x = jnp.asarray(x)
         if op == Average or jnp.issubdtype(x.dtype, jnp.floating):
             x = jnp.asarray(x, jnp.float32)

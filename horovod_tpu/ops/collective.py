@@ -24,6 +24,17 @@ from jax import lax
 from horovod_tpu import compat
 from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
 from horovod_tpu.parallel import mesh as mesh_lib
+from horovod_tpu.telemetry import instruments as _tele
+
+
+def _wire_bytes(x):
+    """Payload bytes of one collective operand (shape is static even for
+    tracers, so this works at trace time)."""
+    try:
+        return int(np.prod(jnp.shape(x)) *
+                   np.dtype(jnp.result_type(x)).itemsize)
+    except Exception:
+        return 0
 
 
 def _resolve_axes(axes):
@@ -75,6 +86,7 @@ def allreduce(x, op=Average, axes=None, compression=None):
     if op not in (Sum, Average, Min, Max, Adasum):
         raise ValueError(f"unknown reduction op: {op!r}")
     axes = _resolve_axes(axes)
+    _tele.record_collective("allreduce", _wire_bytes(x))
     if not _in_named_context(axes):
         return _eager_allreduce(x, op, axes)
     if compression is not None:
@@ -106,6 +118,7 @@ def allgather(x, axes=None, tiled=True):
     live in the eager path, which pads to the negotiated max length.
     """
     axes = _resolve_axes(axes)
+    _tele.record_collective("allgather", _wire_bytes(x))
     if not _in_named_context(axes):
         return _eager_allgather(x, axes)
     out = x
@@ -126,6 +139,7 @@ def broadcast(x, root_rank=0, axes=None):
     collective broadcast when the mask is a single rank.
     """
     axes = _resolve_axes(axes)
+    _tele.record_collective("broadcast", _wire_bytes(x))
     if not _in_named_context(axes):
         return _eager_broadcast(x, root_rank, axes)
     me = mesh_rank(axes)
@@ -146,6 +160,7 @@ def reducescatter(x, op=Sum, axes=None):
     axes = _resolve_axes(axes)
     if op not in (Sum, Average):
         raise ValueError("reducescatter supports Sum or Average")
+    _tele.record_collective("reducescatter", _wire_bytes(x))
     if not _in_named_context(axes):
         return _eager_reducescatter(x, op, axes)
     out = x
@@ -165,6 +180,7 @@ def alltoall(x, axes=None):
     axis slowest — chunk i goes to the shard whose ``mesh_rank`` is i,
     matching every other collective's rank ordering."""
     axes = _resolve_axes(axes)
+    _tele.record_collective("alltoall", _wire_bytes(x))
     if not _in_named_context(axes):
         return _eager_alltoall(x, axes)
     return lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)

@@ -18,6 +18,7 @@ executable.
 """
 
 import dataclasses
+import time
 
 import jax
 import jax.numpy as jnp
@@ -154,24 +155,41 @@ def bucket_schedule(leaves, world, threshold_bytes=None, axes=None,
 def _timeline_mark(kind, idx, nbytes):
     """BUCKET_RS / BUCKET_AG instant markers: emitted at trace time (the
     pipeline is compiled, so per-step device timing lives in the XLA
-    profiler; these markers document the emitted schedule next to it)."""
+    profiler; these markers document the emitted schedule next to it).
+    When a step-dispatch flow is open (``training.make_train_step``
+    stashes its id on the timeline), the marker joins it — linking the
+    dispatch slice to the bucket collectives it scheduled."""
     from horovod_tpu import basics
     tl = basics._state.timeline
     if tl is not None:
-        tl.bucket_marker(kind, idx, nbytes)
+        tl.bucket_marker(kind, idx, nbytes,
+                         flow_id=getattr(tl, "_step_flow_id", None))
+
+
+def _bucket_fill(schedule, idx):
+    used = sum(schedule.buckets[idx].sizes)
+    padded = schedule.padded_sizes[idx]
+    return used / padded if padded else 1.0
 
 
 def reduce_scatter_bucket(schedule, idx, leaves, op=collective.Average):
     """Pack bucket ``idx`` from ``leaves``, pad to the schedule's padded
     size, and reduce-scatter it over the schedule's scatter order. Returns
     this rank's reduced shard (``shard_sizes[idx]`` elements)."""
+    from horovod_tpu import telemetry
+
+    t0 = time.perf_counter()
     bucket = schedule.buckets[idx]
     flat = _pack(bucket, leaves)
     pad = schedule.padded_sizes[idx] - flat.shape[0]
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    _timeline_mark("RS", idx, flat.shape[0] * flat.dtype.itemsize)
-    return collective.reducescatter(flat, op=op, axes=schedule.axes)
+    nbytes = flat.shape[0] * flat.dtype.itemsize
+    _timeline_mark("RS", idx, nbytes)
+    out = collective.reducescatter(flat, op=op, axes=schedule.axes)
+    telemetry.record_bucket("rs", _bucket_fill(schedule, idx), nbytes,
+                            dispatch_s=time.perf_counter() - t0)
+    return out
 
 
 def all_gather_bucket(schedule, idx, shard):
@@ -179,9 +197,15 @@ def all_gather_bucket(schedule, idx, shard):
     shards of bucket ``idx`` back into the full (padded) flat bucket.
     ``collective.allgather`` walks the axes last-to-first, which inverts
     the scatter order, so chunk ownership round-trips exactly."""
-    _timeline_mark("AG", idx,
-                   shard.shape[0] * schedule.world * shard.dtype.itemsize)
-    return collective.allgather(shard, axes=schedule.axes)
+    from horovod_tpu import telemetry
+
+    t0 = time.perf_counter()
+    nbytes = shard.shape[0] * schedule.world * shard.dtype.itemsize
+    _timeline_mark("AG", idx, nbytes)
+    out = collective.allgather(shard, axes=schedule.axes)
+    telemetry.record_bucket("ag", _bucket_fill(schedule, idx), nbytes,
+                            dispatch_s=time.perf_counter() - t0)
+    return out
 
 
 def unpack_bucket(schedule, idx, flat, leaves):

@@ -27,6 +27,10 @@ ARG_TO_ENV = {
     "autotune_gaussian_process_noise":
         "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
     "log_level": "HOROVOD_LOG_LEVEL",
+    # telemetry plane: the launcher value is the BASE port; each rank
+    # serves on base + local_rank (run/launcher.py slot_env)
+    "metrics_port": "HOROVOD_METRICS_PORT",
+    "metrics_addr": "HOROVOD_METRICS_ADDR",
 }
 
 

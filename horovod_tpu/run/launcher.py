@@ -38,6 +38,17 @@ def slot_env(slot, controller_addr, controller_port, rendezvous_addr=None,
         env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(rendezvous_port)
     if extra_env:
         env.update(extra_env)
+    # metrics endpoint: the launcher-level HOROVOD_METRICS_PORT is the
+    # BASE port; each rank serves on base + local_rank so co-located
+    # ranks never collide (0 = every rank binds its own ephemeral port)
+    base = env.get("HOROVOD_METRICS_PORT")
+    if base:
+        try:
+            base_port = int(base)
+        except ValueError:
+            base_port = 0
+        if base_port > 0:
+            env["HOROVOD_METRICS_PORT"] = str(base_port + slot.local_rank)
     return env
 
 

@@ -137,6 +137,19 @@ def build_parser():
                       default=None)
     tune.add_argument("--timeline-filename", default=None)
     tune.add_argument("--timeline-mark-cycles", action="store_true")
+    tune.add_argument("--metrics-port", type=int, default=None,
+                      help="base port for the per-rank Prometheus "
+                           "/metrics + /healthz + /profile endpoints "
+                           "(telemetry plane): rank with local_rank L on "
+                           "each host serves on metrics-port + L; 0 = "
+                           "each rank binds an ephemeral port. Scrape "
+                           "targets are printed at launch "
+                           "(docs/OBSERVABILITY.md)")
+    tune.add_argument("--metrics-addr", default=None,
+                      help="bind address for the metrics endpoints "
+                           "(default 127.0.0.1; the endpoints are "
+                           "unauthenticated — see the security note in "
+                           "docs/OBSERVABILITY.md before exposing them)")
     tune.add_argument("--no-stall-check", action="store_true")
     tune.add_argument("--stall-warning-time-seconds", type=float,
                       default=None)
@@ -146,6 +159,12 @@ def build_parser():
                       choices=["trace", "debug", "info", "warning",
                                "error", "fatal"])
 
+    p.add_argument("--merge-timeline", metavar="OUT", default=None,
+                   help="merge per-rank Chrome trace files into one "
+                        "Perfetto-loadable trace with aligned clocks and "
+                        "per-rank pids, then exit: hvdrun "
+                        "--merge-timeline merged.json trace.rank*.json "
+                        "(same as python -m horovod_tpu.telemetry.merge)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command, e.g. python train.py")
     return p
@@ -161,7 +180,8 @@ def parse_args(argv=None):
         config_parser.load_config_file(args.config_file, args, defaults)
     args.elastic = _validate_elastic_args(parser, args)
     # after the config overlay: the YAML may supply num-proc
-    if not args.check_build and not args.elastic and args.num_proc is None:
+    if (not args.check_build and not args.elastic
+            and args.merge_timeline is None and args.num_proc is None):
         parser.error("-np/--num-proc is required")
     return args
 
@@ -214,6 +234,39 @@ def free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _check_metrics_ports(args, slots):
+    """Per-rank metrics ports (base + local_rank), collision-checked the
+    same way the service ports are (a bind probe — only meaningful for
+    local slots; remote hosts fail loudly at worker bind time). Prints
+    the scrape targets so an operator can paste them into a Prometheus
+    config. Returns the (host, port) target list."""
+    if args.metrics_port is None:
+        return []
+    targets = [(s.hostname, args.metrics_port + s.local_rank)
+               for s in slots]
+    if args.metrics_port > 0:
+        for host, port in targets:
+            if host not in launcher.LOCAL_HOSTS:
+                continue
+            probe = socket.socket()
+            try:
+                probe.bind((args.metrics_addr or "127.0.0.1", port))
+            except OSError as e:
+                raise RuntimeError(
+                    f"hvdrun: metrics port {port} (base "
+                    f"{args.metrics_port} + local_rank) is not bindable "
+                    f"on {host}: {e}; pick another --metrics-port")
+            finally:
+                probe.close()
+        print("hvdrun: metrics scrape targets: "
+              + ", ".join(f"{h}:{p}" for h, p in targets),
+              file=sys.stderr)
+    else:
+        print("hvdrun: metrics on ephemeral ports (base 0); check each "
+              "rank's log for its bound port", file=sys.stderr)
+    return targets
 
 
 def _discover_interfaces(hosts, auth_key, kv_port, args, extra_env):
@@ -357,6 +410,7 @@ def _run(args):
                  else random.randint(23000, 43000))
         extra_env["HOROVOD_COORDINATOR_ADDR"] = f"{controller_addr}:{jport}"
 
+    _check_metrics_ports(args, slots)
     if args.verbose:
         print(f"hvdrun: launching {args.num_proc} processes: "
               f"{[ (s.rank, s.hostname, s.local_rank) for s in slots ]}",
@@ -397,6 +451,11 @@ def _run_elastic(args):
     extra_env = _base_worker_env(args, auth_key, all_local,
                                  initial_host_list, rendezvous_port)
 
+    if args.metrics_port is not None and args.metrics_port > 0:
+        print(f"hvdrun: elastic metrics base port {args.metrics_port}: "
+              "each epoch's scrape targets are host:(base + local_rank) "
+              "over that epoch's slot assignment", file=sys.stderr)
+
     # without an explicit --max-np the job never grows beyond -np: the
     # requested size is the ceiling, elasticity only rides out losses
     max_np = args.max_np if args.max_np is not None else args.num_proc
@@ -426,6 +485,14 @@ def main(argv=None):
     if args.check_build:
         check_build()
         return 0
+    if args.merge_timeline is not None:
+        from horovod_tpu.telemetry import merge as merge_mod
+        traces = [c for c in args.command if c != "--"]
+        if not traces:
+            print("hvdrun: --merge-timeline needs the per-rank trace "
+                  "files as the command arguments", file=sys.stderr)
+            return 1
+        return merge_mod.main(["-o", args.merge_timeline] + traces)
     try:
         _run(args)
     except (RuntimeError, TimeoutError) as e:

@@ -40,16 +40,56 @@ def _resolve_controller_port(cfg):
                        "controller/port", timeout=120).decode())
 
 
+def _rank_timeline_path(path, rank, size):
+    """Per-rank trace paths for the cross-rank merge: multi-process jobs
+    suffix the rank (``trace.rank<r>.json``), single-process keeps the
+    plain path. The native core's C++ timeline still owns the PLAIN path
+    on rank 0, so the Python per-rank files never collide with it."""
+    if size <= 1:
+        return path
+    base, ext = os.path.splitext(path)
+    return f"{base}.rank{rank}{ext or '.json'}"
+
+
 def start(state):
     cfg = state.config
     native_core = bool(cfg.controller_addr and cfg.size > 1)
-    # the native core's C++ timeline owns HOROVOD_TIMELINE in multi-process
-    # jobs; the Python timeline covers the single-process compiled path
-    if cfg.timeline and cfg.rank == 0 and not native_core:
+    # every rank writes its own host trace (pid = rank) so the telemetry
+    # merge tool can build one cross-rank view; the native core's C++
+    # timeline additionally records rank 0's negotiation plane at the
+    # un-suffixed path
+    if cfg.timeline:
         from horovod_tpu.utils.timeline import Timeline
-        state.timeline = Timeline(cfg.timeline,
-                                  mark_cycles=cfg.timeline_mark_cycles)
-        logger.info("timeline enabled -> %s", cfg.timeline)
+        path = _rank_timeline_path(cfg.timeline, cfg.rank, cfg.size)
+        state.timeline = Timeline(path,
+                                  mark_cycles=cfg.timeline_mark_cycles,
+                                  rank=cfg.rank,
+                                  host=os.environ.get("HOROVOD_HOSTNAME"))
+        logger.info("timeline enabled -> %s", path)
+    if cfg.metrics_port is not None:
+        from horovod_tpu import telemetry
+
+        def _health():
+            reg = telemetry.get_registry()
+            steps = reg.get(telemetry.instruments.STEP_TOTAL)
+            return {"rank": cfg.rank, "size": cfg.size,
+                    "step": int(steps.value) if steps is not None else 0}
+
+        telemetry.install_compile_listeners()
+        # the stalled-ranks gauge must be scrapeable even before (or
+        # without) a StallInspector: 0 = nothing known to be stalled
+        telemetry.instruments.stalled_ranks_gauge().set(0)
+        state.metrics_server = telemetry.MetricsServer(
+            addr=cfg.metrics_addr, port=cfg.metrics_port,
+            health_fn=_health, profile_dir=cfg.profile_dir)
+        try:
+            state.metrics_server.start()
+        except OSError as e:
+            logger.warning(
+                "metrics endpoint failed to bind %s:%s (%s); telemetry "
+                "recording stays on, the scrape plane is off",
+                cfg.metrics_addr, cfg.metrics_port, e)
+            state.metrics_server = None
     if native_core:
         from horovod_tpu import _core
         advertise = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
@@ -100,6 +140,9 @@ def start(state):
 
 
 def stop(state):
+    if state.metrics_server is not None:
+        state.metrics_server.stop()
+        state.metrics_server = None
     if state.stall_inspector is not None:
         state.stall_inspector.stop()
         state.stall_inspector = None

@@ -1,0 +1,287 @@
+"""The framework's standard instrument catalogue.
+
+One module owns every built-in metric name so the Prometheus scrape, the
+KV heartbeat snapshot, the bench ``telemetry`` block and the docs
+catalogue (docs/OBSERVABILITY.md) cannot drift apart. Subsystems call
+the ``record_*`` helpers; nothing else hardcodes a metric name.
+
+Enablement: instrumentation that would CHANGE a compiled program (the
+grad-norm output in ``training.make_train_step``) or add per-step host
+work is gated on :func:`enabled` — on when a metrics endpoint is
+configured (``HOROVOD_METRICS_PORT``) or ``HOROVOD_TELEMETRY=1``, so a
+job that never asked for telemetry runs byte-identical programs.
+Registry writes themselves are always safe to make (they are how the
+elastic driver's launcher-side metrics work with no endpoint at all).
+"""
+
+import os
+import time
+
+from horovod_tpu.telemetry.registry import get_registry
+
+# -- step / training plane --------------------------------------------------
+STEP_TOTAL = "horovod_step_total"
+STEP_SECONDS = "horovod_step_latency_seconds"
+STEP_DISPATCH_SECONDS = "horovod_step_dispatch_seconds"
+MICROBATCH_SECONDS = "horovod_microbatch_seconds"
+EXAMPLES_TOTAL = "horovod_examples_total"
+EXAMPLES_PER_SEC = "horovod_examples_per_second"
+LOSS = "horovod_loss"
+GRAD_NORM = "horovod_grad_norm"
+# -- compilation ------------------------------------------------------------
+COMPILE_CACHE_HITS = "horovod_compile_cache_hits_total"
+COMPILE_CACHE_MISSES = "horovod_compile_cache_misses_total"
+COMPILE_SECONDS = "horovod_compile_seconds_total"
+# -- collectives / fusion ---------------------------------------------------
+COLLECTIVE_CALLS = "horovod_collective_calls_total"
+COLLECTIVE_BYTES = "horovod_collective_bytes_total"
+BUCKET_FILL_RATIO = "horovod_bucket_fill_ratio"
+BUCKET_DISPATCH_SECONDS = "horovod_bucket_dispatch_seconds"
+# -- elastic ----------------------------------------------------------------
+RENDEZVOUS_EPOCHS = "horovod_rendezvous_epochs_total"
+BLACKLIST_HOSTS = "horovod_blacklist_hosts"
+RECOVERY_SECONDS = "horovod_recovery_seconds"
+STRAGGLER_RATIO = "horovod_straggler_step_time_ratio"
+# -- stall inspector --------------------------------------------------------
+STALLED_RANKS = "horovod_stalled_ranks"
+
+
+def enabled(env=None):
+    """True when program-shaping / per-step instrumentation should be on."""
+    env = env if env is not None else os.environ
+    if env.get("HOROVOD_TELEMETRY", "").lower() not in ("", "0", "false",
+                                                        "no", "off"):
+        return True
+    try:
+        from horovod_tpu import basics
+        cfg = basics._state.config
+        if cfg is not None:
+            return cfg.metrics_port is not None
+    except Exception:
+        pass
+    return env.get("HOROVOD_METRICS_PORT", "") != ""
+
+
+class StepInstruments:
+    """Per-train-step recorder shared by ``make_train_step`` wrappers and
+    ``elastic_train_loop``. One instance per built step function; all
+    instances feed the same registry families.
+
+    Step *latency* is the wall time between successive step calls (in
+    steady state the dispatch queue is full, so inter-call time IS the
+    device step time); step *dispatch* is the time the jitted call itself
+    held the host. Loss and grad-norm are stashed as device arrays and
+    only read back when something scrapes (deferred gauges) — recording a
+    step never forces a sync."""
+
+    def __init__(self, registry=None, accum_steps=1):
+        r = registry if registry is not None else get_registry()
+        self.registry = r
+        self._accum = max(1, accum_steps)
+        self.steps = r.counter(STEP_TOTAL, "Completed train-step calls")
+        self.examples = r.counter(EXAMPLES_TOTAL,
+                                  "Examples consumed by train steps")
+        self.step_seconds = r.histogram(
+            STEP_SECONDS, "Wall time between successive train-step calls "
+            "(steady-state device step time)")
+        self.dispatch_seconds = r.histogram(
+            STEP_DISPATCH_SECONDS,
+            "Host time spent dispatching the compiled step")
+        self.micro_seconds = r.histogram(
+            MICROBATCH_SECONDS,
+            "Per-microbatch share of the step wall time (step/accum)")
+        self.examples_per_sec = r.gauge(
+            EXAMPLES_PER_SEC, "Examples/sec from the last step interval")
+        self.loss = r.gauge(LOSS, "Last step loss (deferred readback)")
+        self.grad_norm = r.gauge(
+            GRAD_NORM, "Gradient L2 norm of the last step "
+            "(deferred readback; see docs/OBSERVABILITY.md for the "
+            "per-path definition)")
+        self._last_call = None
+
+    def record_step(self, batch, dispatch_s, loss=None, grad_norm=None,
+                    timeline=None, step_no=None):
+        now = time.perf_counter()
+        self.steps.inc()
+        self.examples.inc(batch)
+        self.dispatch_seconds.observe(dispatch_s)
+        interval = None
+        if self._last_call is not None:
+            interval = now - self._last_call
+            self.step_seconds.observe(interval)
+            self.micro_seconds.observe(interval / self._accum)
+            if interval > 0:
+                self.examples_per_sec.set(batch / interval)
+        self._last_call = now
+        if loss is not None:
+            self.loss.set_function(_deferred_scalar(loss))
+        if grad_norm is not None:
+            self.grad_norm.set_function(_deferred_scalar(grad_norm))
+        if timeline is not None:
+            if interval:  # same zero guard as the gauge above
+                timeline.counter("step", {
+                    "step_ms": round(interval * 1e3, 3),
+                    "examples_per_sec": round(batch / interval, 1)})
+            if step_no is not None:
+                timeline.instant("STEP_DISPATCH",
+                                 args={"step": int(step_no),
+                                       "dispatch_ms":
+                                           round(dispatch_s * 1e3, 3)})
+
+
+def _deferred_scalar(x):
+    """Collect-time readback of a (possibly device) scalar."""
+    def read():
+        try:
+            import jax
+            return float(jax.device_get(x))
+        except Exception:
+            return float("nan")
+    return read
+
+
+# per-(metric, label) child handles, resolved once and reused — the
+# cached-child discipline registry.py prescribes for hot callers (the
+# eager path dispatches collectives per step)
+_child_cache = {}
+
+
+def _calls_child(op_name):
+    child = _child_cache.get(("calls", op_name))
+    if child is None:
+        child = get_registry().counter(
+            COLLECTIVE_CALLS, "Collective op dispatches (trace-time for "
+            "compiled programs, per-call for eager)",
+            label_names=("op",)).labels(op_name)
+        _child_cache[("calls", op_name)] = child
+    return child
+
+
+def _bytes_child(op_name):
+    child = _child_cache.get(("bytes", op_name))
+    if child is None:
+        child = get_registry().counter(
+            COLLECTIVE_BYTES, "Wire bytes moved by collective dispatches",
+            label_names=("op",)).labels(op_name)
+        _child_cache[("bytes", op_name)] = child
+    return child
+
+
+def _bucket_children(kind):
+    pair = _child_cache.get(("bucket", kind))
+    if pair is None:
+        r = get_registry()
+        pair = (
+            r.histogram(BUCKET_FILL_RATIO, "Used fraction of each fusion "
+                        "bucket's padded size",
+                        buckets=tuple(i / 10 for i in range(1, 11)),
+                        label_names=("kind",)).labels(kind),
+            r.histogram(BUCKET_DISPATCH_SECONDS,
+                        "Host time to pack+dispatch one bucket collective",
+                        label_names=("kind",)).labels(kind),
+        )
+        _child_cache[("bucket", kind)] = pair
+    return pair
+
+
+def record_collective(op_name, nbytes):
+    """Per-op call count + wire bytes. Called from the collective
+    dispatch functions, i.e. at TRACE time on the compiled path (the
+    counts describe the collectives baked into each compiled program)
+    and per call on the eager path — docs/OBSERVABILITY.md explains how
+    to read the two."""
+    _calls_child(op_name).inc()
+    _bytes_child(op_name).inc(max(0, int(nbytes)))
+
+
+def record_bucket(kind, fill_ratio, nbytes, dispatch_s=None):
+    """Bucketed reduce-scatter/all-gather pipeline instrumentation."""
+    fill, dispatch = _bucket_children(kind)
+    fill.observe(fill_ratio)
+    _bytes_child(f"bucket_{kind}").inc(max(0, int(nbytes)))
+    if dispatch_s is not None:
+        dispatch.observe(dispatch_s)
+
+
+def stalled_ranks_gauge(registry=None):
+    """The one declaration of ``horovod_stalled_ranks`` — the stall
+    inspector records into it; ``runtime/services.py`` pre-registers it
+    so scrapes expose 0 before (or without) an inspector."""
+    r = registry if registry is not None else get_registry()
+    return r.gauge(STALLED_RANKS,
+                   "Ranks whose last progress is older than the stall "
+                   "warning threshold")
+
+
+def kv_snapshot(registry=None):
+    """Compact per-rank snapshot for the elastic KV heartbeat path —
+    just what the driver's cluster view needs (step progress, step-time
+    quantiles, examples/sec, wire bytes), a few hundred bytes riding a
+    channel that already exists."""
+    r = registry if registry is not None else get_registry()
+    out = {}
+    steps = r.get(STEP_TOTAL)
+    if steps is not None:
+        out["step"] = steps.value
+    hist = r.get(STEP_SECONDS)
+    if hist is not None and hist.count:
+        out["step_seconds_p50"] = hist.quantile(0.5)
+        out["step_seconds_p90"] = hist.quantile(0.9)
+    eps = r.get(EXAMPLES_PER_SEC)
+    if eps is not None:
+        out["examples_per_sec"] = eps.value
+    cbytes = r.get(COLLECTIVE_BYTES)
+    if cbytes is not None:
+        sample = cbytes.sample()
+        if isinstance(sample, dict):
+            out["collective_bytes"] = sum(sample.values())
+    return out
+
+
+_compile_listener_installed = False
+
+
+def install_compile_listeners():
+    """Count jax compilation-cache hits/misses and compile seconds via
+    ``jax.monitoring`` events. Idempotent; silently unavailable on jax
+    builds without the monitoring hooks."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    try:
+        from jax import monitoring
+    except Exception:
+        return
+    r = get_registry()
+    hits = r.counter(COMPILE_CACHE_HITS,
+                     "jax compilation-cache hits this process")
+    misses = r.counter(COMPILE_CACHE_MISSES,
+                       "jax compilation-cache misses this process")
+    compile_s = r.counter(COMPILE_SECONDS,
+                          "Cumulative seconds spent in XLA compilation")
+
+    def on_event(event, **kwargs):
+        # a telemetry listener must NEVER throw into jax's dispatch path
+        try:
+            if "cache_hit" in event or event.endswith("cache_hits"):
+                hits.inc()
+            elif "cache_miss" in event or event.endswith("cache_misses"):
+                misses.inc()
+        except Exception:
+            pass
+
+    def on_duration(event, duration, **kwargs):
+        try:
+            # some jax events report negative/relative durations; only
+            # positive compile times are meaningful to accumulate
+            if "compil" in event and duration > 0:
+                compile_s.inc(duration)
+        except Exception:
+            pass
+
+    try:
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+        _compile_listener_installed = True
+    except Exception:
+        pass

@@ -1,0 +1,170 @@
+"""The per-rank HTTP observability plane (stdlib only).
+
+Three endpoints, served from a daemon ``ThreadingHTTPServer`` that
+``runtime/services.py`` starts alongside the controller/stall services
+when ``HOROVOD_METRICS_PORT`` is configured:
+
+* ``GET /metrics``  — the registry in Prometheus text format,
+* ``GET /healthz``  — liveness JSON (rank identity + step progress),
+* ``GET /profile?seconds=N`` — on-demand ``jax.profiler`` device trace:
+  starts a capture into ``HOROVOD_PROFILE_DIR`` (default
+  ``/tmp/horovod_tpu_profile``), stops it after N seconds on a worker
+  thread, responds immediately with the output directory. Load the
+  result in TensorBoard/XProf or Perfetto and line it up with the host
+  trace via docs/OBSERVABILITY.md.
+
+Security note (docs/OBSERVABILITY.md): the server binds
+``HOROVOD_METRICS_ADDR`` = 127.0.0.1 by default. The endpoints are
+UNAUTHENTICATED — ``/profile`` writes to local disk on request — so bind
+a non-loopback address only on networks where every peer is trusted
+(the same trust model as the launcher's control plane).
+"""
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from horovod_tpu.telemetry.registry import get_registry
+
+logger = logging.getLogger("horovod_tpu")
+
+DEFAULT_PROFILE_DIR = "/tmp/horovod_tpu_profile"
+
+
+class MetricsServer:
+    """One rank's scrape endpoint. ``port=0`` binds an ephemeral port
+    (the bound port is in ``.port`` after ``start()``)."""
+
+    def __init__(self, addr="127.0.0.1", port=0, registry=None,
+                 health_fn=None, profile_dir=None):
+        self._addr = addr
+        self._want_port = port
+        self.registry = registry if registry is not None else get_registry()
+        self._health_fn = health_fn
+        self.profile_dir = profile_dir or DEFAULT_PROFILE_DIR
+        self._httpd = None
+        self._thread = None
+        self._profile_lock = threading.Lock()
+        self._profile_active = False
+        self._profile_thread = None
+        self._profile_cancel = threading.Event()
+        self.port = None
+
+    # -- profiling ----------------------------------------------------------
+    def _start_profile(self, seconds):
+        """Kick off a jax.profiler capture on a worker thread and return
+        immediately (a cold profiler start can take >10 s — the HTTP
+        handler must not block on it). One capture at a time; the guard
+        holds until the capture is stopped and written. ``stop()``
+        cancels a running capture and JOINS the thread — a profiler
+        native call racing interpreter teardown segfaults the process,
+        turning a clean worker exit into a blamed failure."""
+        with self._profile_lock:
+            if self._profile_active:
+                return None  # already capturing
+            self._profile_active = True
+            self._profile_cancel.clear()
+
+        def _capture():
+            import jax
+            try:
+                jax.profiler.start_trace(self.profile_dir)
+                self._profile_cancel.wait(seconds)
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.warning("profile capture failed", exc_info=True)
+            finally:
+                with self._profile_lock:
+                    self._profile_active = False
+
+        self._profile_thread = threading.Thread(
+            target=_capture, daemon=True, name="hvd_tpu_profile")
+        self._profile_thread.start()
+        return self.profile_dir
+
+    # -- server -------------------------------------------------------------
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no stderr chatter
+                logger.debug("metrics server: " + fmt, *args)
+
+            def _respond(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                try:
+                    if url.path == "/metrics":
+                        self._respond(
+                            200, server.registry.render_prometheus(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    elif url.path == "/healthz":
+                        health = {"status": "ok"}
+                        if server._health_fn is not None:
+                            health.update(server._health_fn() or {})
+                        self._respond(200, json.dumps(health),
+                                      "application/json")
+                    elif url.path == "/profile":
+                        q = parse_qs(url.query)
+                        seconds = float(q.get("seconds", ["3"])[0])
+                        seconds = min(max(seconds, 0.1), 600.0)
+                        out = server._start_profile(seconds)
+                        if out is None:
+                            self._respond(409, json.dumps(
+                                {"error": "a profile capture is already "
+                                          "running"}), "application/json")
+                        else:
+                            self._respond(200, json.dumps(
+                                {"profiling_seconds": seconds,
+                                 "output_dir": out}), "application/json")
+                    else:
+                        self._respond(404, "not found\n", "text/plain")
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # keep the plane up, report the err
+                    logger.warning("metrics endpoint %s failed: %s",
+                                   url.path, e)
+                    try:
+                        self._respond(500, f"{e}\n", "text/plain")
+                    except Exception:
+                        pass
+
+        return Handler
+
+    def start(self):
+        self._httpd = ThreadingHTTPServer((self._addr, self._want_port),
+                                          self._handler_class())
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="hvd_tpu_metrics",
+                                        daemon=True)
+        self._thread.start()
+        logger.info("metrics endpoint on http://%s:%d/metrics",
+                    self._addr, self.port)
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._profile_thread is not None:
+            # end any in-flight capture NOW and wait for the profiler's
+            # native write to finish before the interpreter can exit
+            self._profile_cancel.set()
+            self._profile_thread.join(timeout=30)
+            self._profile_thread = None

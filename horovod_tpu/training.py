@@ -112,7 +112,7 @@ def _placer(mesh, spec):
 
 def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                     batch_axes=None, donate=True, dropout_seed=0,
-                    accum_steps=1, overlap_grads=False):
+                    accum_steps=1, overlap_grads=False, telemetry=None):
     """Build a jitted SPMD classification train step.
 
     Returns ``step(state, inputs, labels) -> (state, loss)`` where
@@ -140,10 +140,25 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     ``accum_steps=1`` baseline up to reduction-order tolerance when the
     model is microbatch-invariant (no BatchNorm across microbatches).
     ``overlap_grads`` requires ``tx`` to be a ``DistributedOptimizer``.
+
+    ``telemetry`` (default: auto — on when a metrics endpoint is
+    configured, see ``horovod_tpu.telemetry.enabled``) instruments the
+    returned step: step latency / examples-per-sec / dispatch-time
+    metrics plus deferred loss and grad-norm gauges, timeline counter
+    events, and a flow linking the tracing dispatch to its bucket
+    markers. When on, the compiled program additionally computes the
+    gradient L2 norm (exact norm of the globally-averaged gradient in
+    the overlapped paths; root-mean of per-shard local norms otherwise —
+    docs/OBSERVABILITY.md); when off the program is byte-identical to
+    the uninstrumented build.
     """
     from horovod_tpu import hvd_jax
+    from horovod_tpu import telemetry as telemetry_lib
     from horovod_tpu.ops import fusion
     from horovod_tpu.parallel import zero as zero_lib
+
+    tele_on = (telemetry_lib.enabled() if telemetry is None
+               else bool(telemetry))
 
     mesh = mesh if mesh is not None else mesh_lib.get_mesh()
     data_axes = batch_axes or mesh_lib.data_axis_names(mesh)
@@ -245,8 +260,17 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                 state, state.batch_stats, inputs, labels, base_rng)
 
         inv_k = 1.0 / accum_steps
+        gnorm = None
         if overlap_grads:
             shards = [s * jnp.asarray(inv_k, s.dtype) for s in acc_shards]
+            if tele_on:
+                # shards partition the globally-averaged gradient: the
+                # psum of shard sum-squares IS its exact norm² (the pad
+                # zeros contribute nothing)
+                local_sq = sum(jnp.sum(jnp.square(s.astype(jnp.float32)))
+                               for s in shards)
+                gnorm = jnp.sqrt(collective.allreduce(
+                    local_sq, op=collective.Sum, axes=reduce_axes))
             if sharded_tx:
                 grad_rows = {f"b{i}": s[None] for i, s in enumerate(shards)}
                 updates, opt_state = zero_lib.apply_shards(
@@ -266,6 +290,16 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
             if pipelined:
                 grads = jax.tree_util.tree_map(
                     lambda g: g * jnp.asarray(inv_k, g.dtype), acc_grads)
+            if tele_on:
+                # grads here are LOCAL (reduction happens inside tx):
+                # the root-mean across ranks of local norm² — an upper
+                # bound of the averaged-grad norm (Jensen), and the
+                # divergence signal observability wants
+                local_sq = sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads))
+                gnorm = jnp.sqrt(collective.allreduce(
+                    local_sq, op=collective.Average, axes=reduce_axes))
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
 
@@ -278,14 +312,17 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                                     op=collective.Average, axes=data_axes)
         new_state = TrainState(params=params, opt_state=opt_state,
                                batch_stats=stats, step=state.step + 1)
+        if tele_on:
+            return new_state, loss, gnorm
         return new_state, loss
 
     def outer(state, inputs, labels):
         specs = state_specs(state)
+        out_specs = (specs, P(), P()) if tele_on else (specs, P())
         sharded = jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(specs, P(data_axes), P(data_axes)),
-            out_specs=(specs, P()),
+            out_specs=out_specs,
             check_vma=False)
         return sharded(state, inputs, labels)
 
@@ -295,9 +332,48 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     def place_state(state):
         return _placer(mesh, state_specs(state))(state)
 
-    def step(state, inputs, labels):
-        return jitted(place_state(state), place_data(inputs),
-                      place_data(labels))
+    if not tele_on:
+        def step(state, inputs, labels):
+            return jitted(place_state(state), place_data(inputs),
+                          place_data(labels))
+    else:
+        from horovod_tpu import basics as _basics
+        import time as _time
+
+        instruments = telemetry_lib.StepInstruments(accum_steps=accum_steps)
+        first_trace = [True]
+
+        def step(state, inputs, labels):
+            tl = _basics._state.timeline
+            flow = None
+            if tl is not None and first_trace[0]:
+                # the first call traces: open an enclosing slice + flow
+                # on the marker tid so the bucket markers emitted during
+                # tracing link back to this dispatch (ops/fusion reads
+                # _step_flow_id; flows need a B/E slice on their tid to
+                # bind in Perfetto's legacy-JSON importer)
+                tl.start_activity("marker", "step_trace_dispatch")
+                flow = tl.flow_start("step_dispatch")
+                tl._step_flow_id = flow
+            t0 = _time.perf_counter()
+            try:
+                new_state, loss, gnorm = jitted(
+                    place_state(state), place_data(inputs),
+                    place_data(labels))
+            finally:
+                if flow is not None:
+                    first_trace[0] = False
+                    tl._step_flow_id = None
+                    tl.flow_end("step_dispatch", flow)
+                    tl.end_activity("marker")
+            instruments.record_step(
+                batch=int(inputs.shape[0]),
+                dispatch_s=_time.perf_counter() - t0,
+                loss=loss, grad_norm=gnorm, timeline=tl,
+                step_no=instruments.steps.value)
+            return new_state, loss
+
+        step.instruments = instruments
 
     step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
 
@@ -324,8 +400,29 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
     ``batch_fn(step) -> (inputs, labels)`` supplies data (step-indexed so
     a restored worker re-reads the right batch); ``on_step(step, loss)``
     is an optional observer. Returns the final ``TrainState``.
+
+    When telemetry is enabled and ``train_step`` is not already an
+    instrumented ``make_train_step`` build, the loop records step
+    latency / examples-per-sec itself, so a hand-written step function
+    still shows up on the metrics plane.
     """
+    import time as _time
+
     from horovod_tpu import elastic as _elastic
+    from horovod_tpu import telemetry as telemetry_lib
+
+    own_instruments = None
+    if telemetry_lib.enabled() and not hasattr(train_step, "instruments"):
+        own_instruments = telemetry_lib.StepInstruments()
+
+    def _batch_of(inputs):
+        # hand-written steps may take pytree batches; any leaf's leading
+        # dim is the per-call example count (0 when unknowable)
+        leaves = jax.tree_util.tree_leaves(inputs)
+        try:
+            return int(leaves[0].shape[0])
+        except (IndexError, AttributeError, TypeError):
+            return 0
 
     def _step_of(ts):
         return int(jax.device_get(ts.step))
@@ -334,7 +431,14 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
     def _loop(state):
         while _step_of(state.train_state) < num_steps:
             inputs, labels = batch_fn(_step_of(state.train_state))
+            t0 = _time.perf_counter()
             new_ts, loss = train_step(state.train_state, inputs, labels)
+            if own_instruments is not None:
+                from horovod_tpu import basics as _basics
+                own_instruments.record_step(
+                    batch=_batch_of(inputs),
+                    dispatch_s=_time.perf_counter() - t0, loss=loss,
+                    timeline=_basics._state.timeline)
             state.train_state = new_ts
             done = _step_of(new_ts)
             if on_step is not None:

@@ -1,0 +1,129 @@
+"""Direct StallInspector unit tests with a fake clock.
+
+The inspector's watchdog is a pure function of time (``check_once`` on
+an injectable ``clock``), so these tests drive stalls, recoveries, and
+the shutdown threshold without sleeping."""
+
+from horovod_tpu.runtime.stall import StallInspector
+from horovod_tpu.telemetry import get_registry, instruments
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _stalled_gauge():
+    return get_registry().get(instruments.STALLED_RANKS).value
+
+
+def test_no_warning_before_threshold(caplog):
+    clk = FakeClock()
+    insp = StallInspector(warning_time=60.0, clock=clk)
+    clk.advance(59.0)
+    with caplog.at_level("WARNING", logger="horovod_tpu"):
+        stalled = insp.check_once()
+    assert stalled == []
+    assert _stalled_gauge() == 0
+    assert not any("stalled" in r.message for r in caplog.records)
+
+
+def test_warning_fires_once_per_episode(caplog):
+    clk = FakeClock()
+    insp = StallInspector(warning_time=60.0, clock=clk)
+    clk.advance(61.0)
+    with caplog.at_level("WARNING", logger="horovod_tpu"):
+        insp.check_once()
+        insp.check_once()  # same episode: no duplicate warning
+    warns = [r for r in caplog.records if "stalled" in r.message]
+    assert len(warns) == 1
+    assert _stalled_gauge() == 1
+
+
+def test_progress_resets_episode(caplog):
+    clk = FakeClock()
+    insp = StallInspector(warning_time=60.0, clock=clk)
+    clk.advance(61.0)
+    with caplog.at_level("WARNING", logger="horovod_tpu"):
+        insp.check_once()
+        insp.record_progress(step=1)   # recovery
+        assert insp.check_once() == []
+        assert _stalled_gauge() == 0
+        clk.advance(61.0)              # second stall: warns again
+        insp.check_once()
+    warns = [r for r in caplog.records if "stalled" in r.message]
+    assert len(warns) == 2
+
+
+def test_shutdown_time_respected():
+    clk = FakeClock()
+    fired = []
+    insp = StallInspector(warning_time=10.0, shutdown_time=30.0,
+                          clock=clk, on_shutdown=lambda: fired.append(1))
+    clk.advance(15.0)
+    insp.check_once()
+    assert not insp.shutdown_requested  # warned, below shutdown threshold
+    clk.advance(16.0)
+    insp.check_once()
+    assert insp.shutdown_requested
+    assert fired == [1]
+    insp.check_once()  # idempotent: the hook fires once
+    assert fired == [1]
+
+
+def test_shutdown_disabled_by_default():
+    clk = FakeClock()
+    insp = StallInspector(warning_time=10.0, clock=clk)
+    clk.advance(1e6)
+    insp.check_once()
+    assert not insp.shutdown_requested
+
+
+def test_stalled_ranks_gauge_from_heartbeats():
+    """With a cluster heartbeat view, the gauge counts the ranks whose
+    last progress is older than the warning threshold — and the warning
+    names them."""
+    clk = FakeClock(t=100.0)
+    beats = {0: 95.0, 1: 20.0, 2: 10.0}  # ranks 1, 2 stalled at t=100
+    insp = StallInspector(warning_time=60.0, heartbeat_fn=lambda: beats,
+                          clock=clk)
+    stalled = insp.check_once()
+    assert sorted(stalled) == [1, 2]
+    assert _stalled_gauge() == 2
+
+
+def test_check_interval_independent_of_warning_time():
+    """The background loop's cadence is check_interval, not
+    warning_time: a 600 s warning threshold with a short interval still
+    detects the shutdown threshold promptly. Driven via check_once to
+    keep the test clockless."""
+    clk = FakeClock()
+    insp = StallInspector(warning_time=600.0, shutdown_time=5.0,
+                          check_interval=0.01, clock=clk)
+    assert insp._check_interval == 0.01  # not derived from warning_time
+    clk.advance(6.0)
+    insp.check_once()
+    # shutdown crossed even though the warning threshold never was
+    assert insp.shutdown_requested
+    assert _stalled_gauge() == 0
+
+
+def test_loop_runs_with_real_clock():
+    """start/stop smoke: the thread wakes on check_interval and sets
+    shutdown_requested from a real (tiny) stall."""
+    import time
+
+    insp = StallInspector(warning_time=0.01, shutdown_time=0.02,
+                          check_interval=0.01)
+    insp.start()
+    deadline = time.monotonic() + 5.0
+    while not insp.shutdown_requested and time.monotonic() < deadline:
+        time.sleep(0.01)
+    insp.stop()
+    assert insp.shutdown_requested

@@ -1,0 +1,666 @@
+"""Telemetry plane tests: registry, Prometheus endpoint, timeline
+writer correctness, cross-rank trace merge, and the tier-1 end-to-end
+trace-validity run (3-step CPU training with timeline + metrics on).
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.telemetry import (MetricsRegistry, MetricsServer,
+                                   get_registry, load_events, merge_traces)
+from horovod_tpu.telemetry import instruments
+from horovod_tpu.telemetry.merge import CLOCK_SYNC
+
+
+# ---------------------------------------------------------------------------
+# A tiny Prometheus text-format parser (the test's own, so the scrape
+# contract is pinned independently of our renderer).
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+
+
+def parse_prometheus(text):
+    """Parse exposition text into {(name, labels_frozenset): float},
+    validating TYPE lines reference real sample families."""
+    samples = {}
+    types = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split(None, 3)
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        labels = frozenset(
+            tuple(kv.split("=", 1)) for kv in
+            (m.group("labels").split(",") if m.group("labels") else []))
+        value = float(m.group("value").replace("+Inf", "inf"))
+        samples[(m.group("name"), labels)] = value
+    for name in types:
+        assert any(k[0].startswith(name) for k in samples), \
+            f"TYPE {name} has no samples"
+    return samples
+
+
+def names_of(samples):
+    return {k[0] for k in samples}
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    c = r.counter("t_total", "a counter")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("t_gauge")
+    g.set(2.5)
+    g.inc()
+    g.dec(0.5)
+    assert g.value == 3.0
+    h = r.histogram("t_hist", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 3 and h.sum == pytest.approx(5.55)
+    assert h.quantile(0.5) == 0.5
+
+
+def test_zero_valued_metric_still_renders():
+    r = MetricsRegistry()
+    r.counter("never_incremented_total")
+    samples = parse_prometheus(r.render_prometheus())
+    assert samples[("never_incremented_total", frozenset())] == 0
+
+
+def test_labels_and_render_roundtrip():
+    r = MetricsRegistry()
+    c = r.counter("ops_total", "per-op", label_names=("op",))
+    c.labels("allreduce").inc(3)
+    c.labels("allgather").inc(7)
+    samples = parse_prometheus(r.render_prometheus())
+    assert samples[("ops_total", frozenset({("op", '"allreduce"')}))] == 3
+    assert samples[("ops_total", frozenset({("op", '"allgather"')}))] == 7
+    with pytest.raises(ValueError):
+        c.labels("a", "b")  # wrong label arity
+
+
+def test_reregistration_is_get_or_create():
+    r = MetricsRegistry()
+    a = r.counter("same_total")
+    b = r.counter("same_total")
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("same_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        r.counter("same_total", label_names=("x",))  # label mismatch
+
+
+def test_deferred_gauge_reads_at_collect_time():
+    r = MetricsRegistry()
+    g = r.gauge("lazy")
+    box = [1.0]
+    g.set_function(lambda: box[0])
+    box[0] = 42.0
+    assert g.value == 42.0  # read NOW, not at set_function time
+    g.set(7.0)              # a plain set clears the callback
+    assert g.value == 7.0
+
+
+def test_histogram_cumulative_buckets():
+    r = MetricsRegistry()
+    h = r.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    s = parse_prometheus(r.render_prometheus())
+    le = lambda b: frozenset({("le", f'"{b}"')})  # noqa: E731
+    assert s[("lat_bucket", le("0.01"))] == 1
+    assert s[("lat_bucket", le("0.1"))] == 2
+    assert s[("lat_bucket", le("1"))] == 3
+    assert s[("lat_bucket", le("+Inf"))] == 4
+    assert s[("lat_count", frozenset())] == 4
+
+
+def test_histogram_reservoir_bounded():
+    r = MetricsRegistry()
+    h = r.histogram("res", reservoir_size=16)
+    for v in range(10_000):
+        h.observe(float(v))
+    assert h.count == 10_000
+    child = h._self_child()
+    assert len(child._res) == 16  # never grew
+    assert 0 < h.quantile(0.5) < 10_000
+
+
+def test_registry_thread_safety():
+    r = MetricsRegistry()
+    c = r.counter("racy_total")
+    h = r.histogram("racy_hist")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000
+
+
+def test_snapshot_shapes():
+    r = MetricsRegistry()
+    r.counter("c_total").inc(2)
+    r.histogram("h").observe(1.0)
+    r.counter("lab_total", label_names=("op",)).labels("x").inc()
+    snap = r.snapshot()
+    assert snap["c_total"] == 2
+    assert snap["h"]["count"] == 1
+    assert snap['lab_total{op="x"}'] == 1
+
+
+def test_kv_snapshot_compact():
+    r = MetricsRegistry()
+    r.counter(instruments.STEP_TOTAL).inc(5)
+    r.histogram(instruments.STEP_SECONDS).observe(0.1)
+    r.gauge(instruments.EXAMPLES_PER_SEC).set(100.0)
+    r.counter(instruments.COLLECTIVE_BYTES,
+              label_names=("op",)).labels("allreduce").inc(1024)
+    snap = instruments.kv_snapshot(r)
+    assert snap["step"] == 5
+    assert snap["step_seconds_p50"] == pytest.approx(0.1)
+    assert snap["examples_per_sec"] == 100.0
+    assert snap["collective_bytes"] == 1024
+    assert len(json.dumps(snap)) < 500  # compact enough for a heartbeat
+
+
+# ---------------------------------------------------------------------------
+# HTTP server
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_metrics_server_scrape_and_health():
+    r = MetricsRegistry()
+    r.counter("served_total").inc(9)
+    srv = MetricsServer(port=0, registry=r,
+                        health_fn=lambda: {"rank": 3, "step": 17})
+    port = srv.start()
+    try:
+        status, body = _get(port, "/metrics")
+        assert status == 200
+        assert parse_prometheus(body)[("served_total", frozenset())] == 9
+        status, body = _get(port, "/healthz")
+        health = json.loads(body)
+        assert health == {"status": "ok", "rank": 3, "step": 17}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/nope")
+        assert e.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_profile_endpoint(tmp_path, monkeypatch):
+    """Endpoint contract with the profiler stubbed (a cold
+    ``jax.profiler.start_trace`` costs ~16 s; the real capture is
+    exercised by the slow-marked test below): immediate 200 with the
+    output dir, 409 while a capture is active, guard released after."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    srv = MetricsServer(port=0, profile_dir=str(tmp_path / "prof"))
+    port = srv.start()
+    try:
+        status, body = _get(port, "/profile?seconds=0.2")
+        assert status == 200
+        info = json.loads(body)
+        assert info["output_dir"] == str(tmp_path / "prof")
+        # a second capture while one runs is refused
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/profile?seconds=0.2")
+        assert e.value.code == 409
+        deadline = time.monotonic() + 10
+        while srv._profile_active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert calls == [("start", str(tmp_path / "prof")), ("stop",)]
+        # guard released: a new capture is accepted again
+        status, _ = _get(port, "/profile?seconds=0.1")
+        assert status == 200
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_profile_endpoint_real_capture(tmp_path):
+    """The real jax.profiler round-trip through /profile (slow: a cold
+    profiler start takes ~16 s on CPU)."""
+    srv = MetricsServer(port=0, profile_dir=str(tmp_path / "prof"))
+    port = srv.start()
+    try:
+        status, _ = _get(port, "/profile?seconds=0.3")
+        assert status == 200
+        import jax.numpy as jnp
+        (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+        deadline = time.monotonic() + 60
+        while srv._profile_active and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not srv._profile_active
+        assert (tmp_path / "prof").exists()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Timeline writer + merge
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_close_idempotent_and_valid(tmp_path):
+    from horovod_tpu.utils.timeline import Timeline
+
+    path = tmp_path / "t.json"
+    tl = Timeline(str(path), rank=2, host="worker-a")
+    tl.instant("A", args={"k": 1})
+    tl.start_activity("tensor0", "ALLREDUCE")
+    tl.end_activity("tensor0")
+    tl.counter("step", {"step_ms": 12.5})
+    fid = tl.flow_start("step_dispatch")
+    tl.flow_point("BUCKET_RS", fid)
+    tl.flow_end("step_dispatch", fid)
+    tl.close()
+    tl.close()  # idempotent
+    events = json.load(open(path))
+    names = [e["name"] for e in events]
+    assert "process_name" in names and CLOCK_SYNC in names
+    assert {e["pid"] for e in events} == {2}
+    meta = next(e for e in events if e["name"] == "process_name")
+    assert meta["args"]["name"] == "rank 2 (worker-a)"
+    phases = {e["name"]: e["ph"] for e in events}
+    assert phases["step"] == "C"
+    flows = [e["ph"] for e in events if e.get("cat") == "flow"]
+    assert flows == ["s", "t", "f"]
+
+
+def test_timeline_events_racing_close_not_dropped(tmp_path):
+    """Events enqueued concurrently with close() land in the file (the
+    writer drains past the sentinel)."""
+    from horovod_tpu.utils.timeline import Timeline
+
+    path = tmp_path / "race.json"
+    tl = Timeline(str(path))
+    n_emitters, per_thread = 4, 50
+    barrier = threading.Barrier(n_emitters + 1)
+
+    def emit(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            tl.instant(f"ev_{tid}_{i}")
+
+    threads = [threading.Thread(target=emit, args=(t,))
+               for t in range(n_emitters)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    time.sleep(0.005)  # let emitters race the close below
+    tl.close()
+    for t in threads:
+        t.join()
+    events = json.load(open(path))  # valid JSON regardless of the race
+    emitted = [e for e in events if e["name"].startswith("ev_")]
+    # every event enqueued BEFORE close flipped the flag is in the file;
+    # the exact count depends on the race, but the file must be valid
+    # and must contain a prefix of each thread's sequence
+    for t in range(n_emitters):
+        seq = [int(e["name"].split("_")[2]) for e in emitted
+               if e["name"].startswith(f"ev_{t}_")]
+        assert seq == sorted(seq)
+
+
+def test_timeline_crash_leaves_repairable_file(tmp_path):
+    """No close() (a crashed rank): the flushed prefix parses after
+    repair and keeps every fully-written event."""
+    from horovod_tpu.utils.timeline import Timeline
+
+    path = tmp_path / "crash.json"
+    tl = Timeline(str(path), rank=1)
+    for i in range(20):
+        tl.instant(f"step_{i}")
+    # wait for the writer to drain + flush, then "crash" (no close)
+    deadline = time.monotonic() + 10
+    while not tl._queue.empty() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.05)
+    tl._file.flush()
+    with pytest.raises(json.JSONDecodeError):
+        json.load(open(path))  # truncated: no closing ]
+    events = load_events(str(path))
+    names = [e["name"] for e in events]
+    assert "step_0" in names and "step_19" in names
+
+
+def test_load_events_repairs_half_written_tail(tmp_path):
+    p = tmp_path / "torn.json"
+    good = [{"name": "a", "ph": "i", "ts": 1, "pid": 0},
+            {"name": "b", "ph": "i", "ts": 2, "pid": 0,
+             "args": {"x": {"y": 1}}}]
+    text = "[\n" + ",\n".join(json.dumps(e) for e in good)
+    p.write_text(text + ',\n{"name": "torn", "ph": "i", "ts": 3, "ar')
+    events = load_events(str(p))
+    assert [e["name"] for e in events] == ["a", "b"]
+
+
+def test_load_events_rejects_non_trace(tmp_path):
+    p = tmp_path / "notatrace.json"
+    p.write_text("hello world")
+    with pytest.raises(ValueError):
+        load_events(str(p))
+
+
+def test_merge_aligns_clocks_and_assigns_pids(tmp_path):
+    def write_trace(path, rank, unix0_us, events):
+        evs = [{"name": CLOCK_SYNC, "ph": "i", "ts": 0, "pid": 0,
+                "args": {"unix_time_us": unix0_us, "rank": rank}}]
+        evs += events
+        path.write_text(json.dumps(evs))
+
+    # rank 1's clock started 1500 us after rank 0's
+    a, b = tmp_path / "t.rank0.json", tmp_path / "t.rank1.json"
+    write_trace(a, 0, 10_000_000,
+                [{"name": "s", "ph": "i", "ts": 100, "pid": 0}])
+    write_trace(b, 1, 10_001_500,
+                [{"name": "s", "ph": "i", "ts": 100, "pid": 0}])
+    out = tmp_path / "merged.json"
+    merged = merge_traces([str(a), str(b)], str(out))
+    assert json.load(open(out)) == merged
+    by_pid = {}
+    for e in merged:
+        if e["name"] == "s":
+            by_pid[e["pid"]] = e["ts"]
+    assert set(by_pid) == {0, 1}
+    assert by_pid[1] - by_pid[0] == 1500  # clock shift applied
+    # both ranks got process metadata
+    names = [(e["pid"], e["name"]) for e in merged if e.get("ph") == "M"]
+    assert (0, "process_name") in names and (1, "process_name") in names
+
+
+def test_merge_cli(tmp_path):
+    from horovod_tpu.telemetry import merge as merge_mod
+
+    t = tmp_path / "one.rank0.json"
+    t.write_text(json.dumps(
+        [{"name": "x", "ph": "i", "ts": 5, "pid": 0}]))
+    out = tmp_path / "merged.json"
+    rc = merge_mod.main(["-o", str(out), str(tmp_path / "*.rank*.json")])
+    assert rc == 0
+    assert any(e["name"] == "x" for e in json.load(open(out)))
+
+
+def test_hvdrun_merge_timeline_flag(tmp_path):
+    from horovod_tpu.run import run as run_mod
+
+    t = tmp_path / "t.rank0.json"
+    t.write_text(json.dumps([{"name": "x", "ph": "i", "ts": 1, "pid": 0}]))
+    out = tmp_path / "m.json"
+    rc = run_mod.main(["--merge-timeline", str(out), str(t)])
+    assert rc == 0
+    assert json.load(open(out))
+
+
+# ---------------------------------------------------------------------------
+# allreduce_metrics / MetricAverageCallback edge cases (reference
+# semantics: horovod/_keras/callbacks.py:46-85)
+# ---------------------------------------------------------------------------
+
+
+def test_allreduce_metrics_non_numeric_passthrough(hvd):
+    from horovod_tpu import hvd_jax
+
+    out = hvd_jax.allreduce_metrics(
+        {"loss": 2.0, "run_name": "exp-7", "note": None})
+    assert float(np.asarray(out["loss"])) == pytest.approx(2.0)
+    assert out["run_name"] == "exp-7"
+    assert out["note"] is None
+
+
+def test_allreduce_metrics_empty_and_nested(hvd):
+    from horovod_tpu import hvd_jax
+
+    assert hvd_jax.allreduce_metrics({}) == {}
+    nested = {"train": {"loss": 1.0, "acc": 0.5},
+              "val": {"loss": [2.0, 3.0]}}
+    out = hvd_jax.allreduce_metrics(nested)
+    assert float(np.asarray(out["train"]["loss"])) == pytest.approx(1.0)
+    assert float(np.asarray(out["val"]["loss"][1])) == pytest.approx(3.0)
+
+
+def test_allreduce_metrics_sum_single_process(hvd):
+    from horovod_tpu import hvd_jax
+    from horovod_tpu.ops.reduction import Sum
+
+    out = hvd_jax.allreduce_metrics({"count": np.int32(7)}, op=Sum)
+    assert np.asarray(out["count"]).dtype == np.int32
+    assert int(out["count"]) == 7  # world size 1: identity
+
+
+def test_metric_average_callback_edges(hvd):
+    from horovod_tpu.callbacks import MetricAverageCallback
+
+    cb = MetricAverageCallback()
+    assert cb.on_epoch_end(0, None) is None
+    assert cb.on_epoch_end(0, {}) == {}
+    out = cb.on_epoch_end(
+        0, {"loss": 1.5, "tag": "keep-me", "nested": {"acc": 1}})
+    assert out["loss"] == pytest.approx(1.5)
+    assert isinstance(out["loss"], float)
+    assert out["tag"] == "keep-me"
+    assert out["nested"]["acc"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Elastic driver cluster view / straggler flagging
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_view_flags_two_worker_straggler():
+    """Lower-median regression: on a 2-worker cluster the slowest rank
+    must still be flaggable (the upper-middle 'median' would BE the
+    slowest and the ratio would always read 1.0)."""
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    driver = ElasticDriver(FixedHosts({"hostA": 2}), min_np=2)
+    beats = {0: {"step": 10, "time": 1.0,
+                 "metrics": {"step_seconds_p50": 0.1}},
+             1: {"step": 4, "time": 1.0,
+                 "metrics": {"step_seconds_p50": 1.0}}}
+    driver.worker_progress = lambda: beats
+    view = driver.cluster_view()
+    assert view["straggler_ratio"] == pytest.approx(10.0)
+    assert view["stragglers"] == [1]
+    assert view["ranks"][0]["step"] == 10
+    # flag log is per-epoch rate-limited: second call stays flagged
+    assert driver.cluster_view()["stragglers"] == [1]
+    driver.stop()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end trace validity (the tier-1 acceptance run): 3-step CPU
+# training with timeline + metrics for two "ranks", merged trace loads,
+# /metrics scrape parses and carries the catalogued names.
+# ---------------------------------------------------------------------------
+
+
+def _three_step_run(monkeypatch, tmp_path, rank, size):
+    import jax
+    import optax
+
+    import horovod_tpu as hvd_mod
+    from horovod_tpu import basics, training
+    from horovod_tpu.models.simple import MLP
+
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(tmp_path / "trace.json"))
+    monkeypatch.setenv("HOROVOD_METRICS_PORT", "0")
+    monkeypatch.setenv("HOROVOD_RANK", str(rank))
+    monkeypatch.setenv("HOROVOD_SIZE", str(size))
+    hvd_mod.shutdown()
+    hvd_mod.init()
+    try:
+        model = MLP(features=(16, 10))
+        tx = hvd_mod.DistributedOptimizer(optax.sgd(0.01))
+        rng = np.random.default_rng(rank)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = rng.integers(0, 10, 16).astype(np.int32)
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0), x[:1])
+        # overlap pipeline so BUCKET_RS/AG markers hit the trace
+        step = training.make_train_step(model, tx, accum_steps=2,
+                                        overlap_grads=True)
+        for _ in range(3):
+            state, loss = step(state, x, y)
+        # a membership marker, as the elastic driver emits them
+        basics._state.timeline.membership(
+            "RENDEZVOUS", {"epoch": 1, "np": size})
+        port = basics._state.metrics_server.port
+        _, scrape = _get(port, "/metrics")
+        _, health = _get(port, "/healthz")
+    finally:
+        hvd_mod.shutdown()
+    return scrape, json.loads(health)
+
+
+def test_trace_validity_end_to_end(monkeypatch, tmp_path):
+    scrapes = {}
+    for rank in (0, 1):
+        scrape, health = _three_step_run(monkeypatch, tmp_path, rank, 2)
+        assert health["status"] == "ok" and health["rank"] == rank
+        scrapes[rank] = scrape
+
+    # -- the scrape parses and carries the catalogued names --------------
+    samples = parse_prometheus(scrapes[0])
+    got = names_of(samples)
+    for needed in (instruments.STEP_TOTAL,
+                   instruments.EXAMPLES_PER_SEC,
+                   instruments.STALLED_RANKS):
+        assert needed in got, f"scrape missing {needed}"
+    assert instruments.STEP_SECONDS + "_count" in got
+    assert (instruments.COLLECTIVE_BYTES,
+            frozenset({("op", '"bucket_rs"')})) in samples
+    assert samples[(instruments.STEP_TOTAL, frozenset())] == 3
+    assert samples[(instruments.STALLED_RANKS, frozenset())] == 0
+
+    # -- per-rank trace files merge into one valid trace -----------------
+    rank_files = sorted(str(p) for p in tmp_path.glob("trace.rank*.json"))
+    assert len(rank_files) == 2
+    out = tmp_path / "merged.json"
+    merge_traces(rank_files, str(out))
+    merged = json.load(open(out))  # json.load()s: the acceptance bar
+    names = {e["name"] for e in merged}
+    pids = {e["pid"] for e in merged}
+    assert pids == {0, 1}, "distinct per-rank pids"
+    assert "STEP_DISPATCH" in names          # step events
+    assert "BUCKET_RS" in names              # bucket events
+    assert any(e.get("ph") == "C" for e in merged)   # counter events
+    assert "MEMBERSHIP_RENDEZVOUS" in names  # membership events
+    assert any(e["name"] == "process_name" and e["pid"] == 1
+               for e in merged)
+
+
+# ---------------------------------------------------------------------------
+# Instrumentation overhead on the hot step path (slow bench smoke).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_instrumentation_overhead_under_2pct(monkeypatch):
+    """The acceptance bound: telemetry recording on the hot step path
+    costs <2%. Measured directly — the same compiled step driven with
+    and without the instrumented wrapper work (recording into the
+    registry + deferred gauge stash), on a step big enough (~10 ms+)
+    that the bound is meaningful."""
+    import jax
+    import optax
+
+    import horovod_tpu as hvd_mod
+    from horovod_tpu import training
+    from horovod_tpu.models.simple import MLP
+
+    monkeypatch.delenv("HOROVOD_METRICS_PORT", raising=False)
+    hvd_mod.shutdown()
+    hvd_mod.init()
+    try:
+        model = MLP(features=(1024, 1024, 10))
+        tx = hvd_mod.DistributedOptimizer(optax.sgd(0.01))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((256, 512)).astype(np.float32)
+        y = rng.integers(0, 10, 256).astype(np.int32)
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0), x[:1])
+        step = training.make_train_step(model, tx, donate=False,
+                                        telemetry=False)
+        instruments_obj = hvd_mod.telemetry.StepInstruments(
+            registry=MetricsRegistry())
+
+        def run(n):
+            s = state
+            t0 = time.perf_counter()
+            for _ in range(n):
+                s, loss = step(s, x, y)
+            jax.block_until_ready(loss)
+            return time.perf_counter() - t0
+
+        run(3)  # compile + warm
+        iters = 30
+        step_s = min(run(iters) for _ in range(3)) / iters
+
+        # the per-step instrumentation work, timed in isolation (an
+        # A/B wall-clock diff of whole runs drowns the µs-scale record
+        # path in CPU run-to-run noise): everything record_step does,
+        # with a live loss array for the deferred gauges
+        s2, loss = step(state, x, y)
+        jax.block_until_ready(loss)
+        reps = 2000
+        t0 = time.perf_counter()
+        for i in range(reps):
+            t1 = time.perf_counter()
+            instruments_obj.record_step(
+                batch=x.shape[0], dispatch_s=time.perf_counter() - t1,
+                loss=loss, grad_norm=loss)
+        record_s = (time.perf_counter() - t0) / reps
+        overhead = record_s / step_s
+        assert overhead < 0.02, \
+            f"instrumentation overhead {overhead:.2%} >= 2% " \
+            f"(record {record_s * 1e6:.1f} us vs step {step_s * 1e3:.2f} ms)"
+    finally:
+        hvd_mod.shutdown()
