@@ -231,6 +231,24 @@ def _telemetry_block():
             if k.startswith(keep)}
 
 
+def _flightrec_overhead_ns(n=200_000):
+    """Micro-bench the flight recorder's hot-path cost (one collective
+    entry: deque append + CRC chain) so a regression in the
+    "bounded append, no I/O, no locks" contract shows in the BENCH json
+    as flightrec_overhead_ns_per_event."""
+    import time as _time
+
+    from horovod_tpu.diag.recorder import FlightRecorder
+    rec = FlightRecorder(capacity=4096, rank=0, size=1)
+    shape, dtype = (1024, 1024), "float32"
+    t0 = _time.perf_counter()
+    for i in range(n):
+        rec.collective_enter("allreduce", shape=shape, dtype=dtype,
+                             nbytes=4 << 20, mode="trace")
+    dt = _time.perf_counter() - t0
+    return dt / n * 1e9
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet101",
@@ -318,10 +336,16 @@ def main():
     # stays identical across rounds; the JSON records the winner.
     autotuned_mb = None
     autotune_error = None
+    autotune_abstained = None
     try:
-        best_thr, _ = hvd.autotune_fusion_threshold(state.params, trials=5,
-                                                    apply=False)
-        autotuned_mb = best_thr >> 20
+        best_thr, at_timings = hvd.autotune_fusion_threshold(
+            state.params, trials=5, apply=False)
+        if best_thr is None:
+            # abstention contract (docs/AUTOTUNE.md): no rankable signal
+            # -> record null + the reason, never a noise argmin
+            autotune_abstained = at_timings.abstain_reason
+        else:
+            autotuned_mb = best_thr >> 20
     except Exception as e:  # noqa: BLE001 — record, don't die
         autotune_error = str(e).splitlines()[0][:160]
 
@@ -417,10 +441,13 @@ def main():
             lm_try("lm_tokens_per_sec_seq_parallel_flash_b8",
                    flash=True, batch=8, seq_parallel=True)
 
-    if autotuned_mb is not None:
-        result["autotuned_fusion_threshold_mb"] = autotuned_mb
+    result["autotuned_fusion_threshold_mb"] = autotuned_mb
+    if autotune_abstained is not None:
+        result["autotune_abstained"] = autotune_abstained
     if autotune_error is not None:
         result["autotune_error"] = autotune_error
+    result["flightrec_overhead_ns_per_event"] = round(
+        _flightrec_overhead_ns(), 1)
     result["telemetry"] = _telemetry_block()
     print(json.dumps(result))
 

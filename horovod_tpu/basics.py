@@ -47,6 +47,7 @@ class _State:
         self.timeline = None
         self.stall_inspector = None
         self.metrics_server = None
+        self.flight_recorder = None
         self.joined = False
 
 

@@ -94,6 +94,20 @@ class Config:
     metrics_port: int = None
     metrics_addr: str = "127.0.0.1"
     profile_dir: str = None
+    # flight recorder (horovod_tpu/diag): None = auto — on for
+    # multi-process jobs (where post-mortem forensics matter and a
+    # launcher owns the dump dir), off for single-process library use
+    # (no surprise signal handlers inside a host application).
+    # HOROVOD_FLIGHTREC=0/1 forces; _CAPACITY bounds the ring;
+    # _DIR is where flightrec.rank<r>.json dumps land (hvdrun plumbs
+    # this to --output-dir or a run-scoped temp dir).
+    flightrec: bool = None
+    flightrec_capacity: int = 4096
+    flightrec_dir: str = None
+
+    @property
+    def flightrec_enabled(self):
+        return self.size > 1 if self.flightrec is None else self.flightrec
 
     # --- stall inspector (stall_inspector.h:30-70) ---
     stall_check_disable: bool = False
@@ -142,6 +156,10 @@ class Config:
             metrics_port=_env_int("HOROVOD_METRICS_PORT", None),
             metrics_addr=_env_str("HOROVOD_METRICS_ADDR", "127.0.0.1"),
             profile_dir=_env_str("HOROVOD_PROFILE_DIR"),
+            flightrec=(None if _env_str("HOROVOD_FLIGHTREC") is None
+                       else _env_bool("HOROVOD_FLIGHTREC")),
+            flightrec_capacity=_env_int("HOROVOD_FLIGHTREC_CAPACITY", 4096),
+            flightrec_dir=_env_str("HOROVOD_FLIGHTREC_DIR"),
             log_level=_env_str("HOROVOD_LOG_LEVEL", "warning"),
             log_hide_timestamp=_env_bool("HOROVOD_LOG_HIDE_TIME"),
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),

@@ -1,0 +1,53 @@
+"""Black-box crash forensics: flight recorder + collective desync doctor.
+
+The reference's sharpest debugging tools only speak while the process is
+alive: the stall inspector names the ranks missing from a pending
+collective (``horovod/common/stall_inspector.cc``) and the controller
+negotiation rejects shape/dtype mismatches before they hang
+(``controller.cc:55-346``). The telemetry plane (``horovod_tpu.telemetry``)
+has the same limitation — a SIGKILLed rank or a wedged TPU runtime takes
+its metrics with it. This package is the piece every production trainer
+needs and live telemetry cannot provide: post-mortem answers to *which
+rank died, in which collective, and who was left waiting* without
+re-running the job.
+
+Three parts:
+
+* :mod:`~horovod_tpu.diag.recorder` — a per-rank bounded, lock-cheap ring
+  buffer of structured events (collective entry/exit with op/name/shape/
+  dtype and a per-rank ``collective_seq``, step boundaries, rendezvous
+  epochs, heartbeats, config fingerprint), dumped to
+  ``flightrec.rank<r>.json`` on crash (``sys.excepthook`` +
+  SIGTERM/SIGABRT + ``atexit``), on stall-inspector firing, and on demand
+  via the telemetry endpoint ``GET /flightrec``.
+* :mod:`~horovod_tpu.diag.desync` — ranks publish a compact rolling digest
+  (``seq`` + a hash of the op/name/shape schedule) on the elastic KV
+  heartbeats; the driver's cluster view cross-checks digests so a rank
+  that diverged in collective order (or stopped advancing) is named
+  *while the job hangs*.
+* :mod:`~horovod_tpu.diag.doctor` — ``hvdrun --doctor <logdir>`` (and
+  ``python -m horovod_tpu.diag.doctor``) aggregates per-rank dumps into
+  one human-readable hang report: last common ``collective_seq``, the
+  collective each straggler is parked in, ranks with no dump
+  (hard-killed), a clock-aligned last-event timeline, and a
+  probable-cause classification (dead rank / desync / data stall /
+  compile stall).
+
+Hot-path discipline: recording is a bounded deque append plus a CRC
+update — no I/O, no locks — and the recorder never touches the traced
+computation, so compiled programs are byte-identical whether the
+recorder is installed or not (asserted by ``tests/test_diag.py``).
+"""
+
+from horovod_tpu.diag.recorder import (FlightRecorder, config_fingerprint,
+                                       dump_now, get_recorder, install,
+                                       uninstall)
+from horovod_tpu.diag import desync
+
+# NOTE: doctor is deliberately NOT imported here — `python -m
+# horovod_tpu.diag.doctor` must not find the module pre-imported by its
+# own package (runpy RuntimeWarning); import it as
+# `from horovod_tpu.diag import doctor`.
+
+__all__ = ["FlightRecorder", "config_fingerprint", "dump_now",
+           "get_recorder", "install", "uninstall", "desync"]

@@ -27,6 +27,7 @@ import sys
 import threading
 import time
 
+from horovod_tpu.diag import desync as desync_lib
 from horovod_tpu.elastic.discovery import HostDiscoveryPoller
 from horovod_tpu.elastic.notification import WorkerNotificationClient
 from horovod_tpu.run import allocation
@@ -129,6 +130,8 @@ class ElasticDriver:
         self._current_slots = []
         self._membership_dirty = False
         self._flagged_stragglers = set()
+        self._flagged_desync = set()
+        self._last_digests = None
         self._poller = HostDiscoveryPoller(
             discovery, poll_interval=poll_interval,
             on_update=self._on_hosts_updated)
@@ -317,7 +320,41 @@ class ElasticDriver:
                 ">%.1fx the cluster median (%s)", self.epoch, fresh,
                 STRAGGLER_THRESHOLD,
                 {r: round(step_times[r], 4) for r in fresh})
+        view["flightrec"] = self._cross_check_digests(progress)
         return view
+
+    def _cross_check_digests(self, progress):
+        """Desync detection while the job hangs: compare the flight-
+        recorder digests riding the heartbeats (seq + collective-schedule
+        hash, ``horovod_tpu.diag.desync``) and NAME the rank whose
+        schedule diverged or whose seq stopped advancing — the live
+        mirror of the reference controller's shape/dtype mismatch checks
+        (``controller.cc:55-346``), working post-negotiation and for the
+        compiled plane's trace-time schedules."""
+        digests = {r: hb.get("flightrec") for r, hb in progress.items()
+                   if hb.get("flightrec")}
+        check = desync_lib.cross_check(digests, prev=self._last_digests)
+        self._last_digests = digests or self._last_digests
+        fresh = [r for r in check["desynced"]
+                 if r not in self._flagged_desync]
+        if fresh:
+            self._flagged_desync.update(fresh)
+            logger.error(
+                "elastic: epoch %d DESYNC — rank(s) %s diverged from the "
+                "majority collective schedule (%s); their compiled/eager "
+                "collective order no longer matches the cluster",
+                self.epoch, fresh, check.get("detail"))
+            self._membership_event(
+                "DESYNC", {"epoch": self.epoch, "ranks": fresh,
+                           "detail": check.get("detail")})
+        if check["stuck"]:
+            logger.warning(
+                "elastic: epoch %d rank(s) %s stopped advancing their "
+                "collective seq while peers progressed (%s) — dead data "
+                "feed or wedged collective; flight-recorder dumps will "
+                "name the op (hvdrun --doctor)", self.epoch,
+                check["stuck"], check["seqs"])
+        return check
 
     # -- rendezvous ----------------------------------------------------------
     def rendezvous(self):
@@ -333,6 +370,8 @@ class ElasticDriver:
         slots = allocation.allocate(host_list, np_now)
         self._current_slots = slots
         self._flagged_stragglers = set()
+        self._flagged_desync = set()
+        self._last_digests = None  # fresh processes restart their seqs
         self._m_epochs.inc()
         self._m_blacklist.set(sum(
             1 for h in self._poller.current()

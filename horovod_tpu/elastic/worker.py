@@ -59,6 +59,11 @@ class WorkerContext:
             key=self._key, manager=self.manager,
             host="0.0.0.0" if self._key else "127.0.0.1")
         self._publish_endpoint()
+        try:
+            from horovod_tpu.diag import recorder as _flightrec
+            _flightrec.record_event("epoch", epoch=self.epoch)
+        except Exception:
+            pass
 
     def _advertised_addr(self):
         """An address the DRIVER can dial: this host's primary IP, or
@@ -101,6 +106,17 @@ class WorkerContext:
                 payload["metrics"] = metrics
         except Exception:
             pass  # telemetry must never break the liveness channel
+        try:
+            from horovod_tpu.diag import recorder as _flightrec
+            _flightrec.record_event("heartbeat", step=step)
+            digest = _flightrec.current_digest()
+            if digest:
+                # the desync plane rides the channel that already
+                # exists: seq + schedule hash (+ a short history) so the
+                # driver can name a diverged/stuck rank WHILE it hangs
+                payload["flightrec"] = digest
+        except Exception:
+            pass  # forensics must never break the liveness channel
         try:
             kv_put(self._kv_addr, self._kv_port,
                    f"elastic/heartbeat/{self.epoch}/{self.rank}",

@@ -22,9 +22,29 @@ import numpy as np
 from jax import lax
 
 from horovod_tpu import compat
+from horovod_tpu.diag import recorder as _flightrec
 from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.telemetry import instruments as _tele
+
+
+def _eager_recorded(op_name, fn, x, nbytes, hash_shape=True):
+    """Run the eager collective ``fn`` bracketed by flight-recorder
+    entry/exit events: a rank that blocks (or dies) inside the call
+    leaves a dangling entry naming the collective it is parked in —
+    the post-mortem analogue of the reference stall inspector's
+    per-tensor missing-ranks view (``stall_inspector.cc``). No recorder
+    installed -> two no-op calls. ``hash_shape=False`` keeps the operand
+    shape out of the desync digest for variable-length collectives."""
+    seq = _flightrec.collective_enter(op_name, x, nbytes=nbytes,
+                                      mode="eager", hash_shape=hash_shape)
+    ok = False
+    try:
+        out = fn()
+        ok = True
+        return out
+    finally:
+        _flightrec.collective_exit(op_name, seq, ok=ok)
 
 
 def _wire_bytes(x):
@@ -86,9 +106,13 @@ def allreduce(x, op=Average, axes=None, compression=None):
     if op not in (Sum, Average, Min, Max, Adasum):
         raise ValueError(f"unknown reduction op: {op!r}")
     axes = _resolve_axes(axes)
-    _tele.record_collective("allreduce", _wire_bytes(x))
+    nbytes = _wire_bytes(x)
+    _tele.record_collective("allreduce", nbytes)
     if not _in_named_context(axes):
-        return _eager_allreduce(x, op, axes)
+        return _eager_recorded("allreduce",
+                               lambda: _eager_allreduce(x, op, axes),
+                               x, nbytes)
+    _flightrec.collective_enter("allreduce", x, nbytes=nbytes, mode="trace")
     if compression is not None:
         x, ctx = compression.compress(x)
     if op == Sum:
@@ -118,9 +142,16 @@ def allgather(x, axes=None, tiled=True):
     live in the eager path, which pads to the negotiated max length.
     """
     axes = _resolve_axes(axes)
-    _tele.record_collective("allgather", _wire_bytes(x))
+    nbytes = _wire_bytes(x)
+    _tele.record_collective("allgather", nbytes)
     if not _in_named_context(axes):
-        return _eager_allgather(x, axes)
+        # hash_shape=False: the eager path carries allgatherv semantics
+        # (per-rank first dims may differ by design), so the shape must
+        # not enter the cross-rank schedule digest
+        return _eager_recorded("allgather",
+                               lambda: _eager_allgather(x, axes),
+                               x, nbytes, hash_shape=False)
+    _flightrec.collective_enter("allgather", x, nbytes=nbytes, mode="trace")
     out = x
     # Gather over the minor axis first so the result is ordered by
     # linearized mesh_rank (major axis varies slowest).
@@ -139,9 +170,13 @@ def broadcast(x, root_rank=0, axes=None):
     collective broadcast when the mask is a single rank.
     """
     axes = _resolve_axes(axes)
-    _tele.record_collective("broadcast", _wire_bytes(x))
+    nbytes = _wire_bytes(x)
+    _tele.record_collective("broadcast", nbytes)
     if not _in_named_context(axes):
-        return _eager_broadcast(x, root_rank, axes)
+        return _eager_recorded("broadcast",
+                               lambda: _eager_broadcast(x, root_rank, axes),
+                               x, nbytes)
+    _flightrec.collective_enter("broadcast", x, nbytes=nbytes, mode="trace")
     me = mesh_rank(axes)
     contrib = jnp.where(me == root_rank, x, jnp.zeros_like(x))
     return lax.psum(contrib, axes)
@@ -160,9 +195,14 @@ def reducescatter(x, op=Sum, axes=None):
     axes = _resolve_axes(axes)
     if op not in (Sum, Average):
         raise ValueError("reducescatter supports Sum or Average")
-    _tele.record_collective("reducescatter", _wire_bytes(x))
+    nbytes = _wire_bytes(x)
+    _tele.record_collective("reducescatter", nbytes)
     if not _in_named_context(axes):
-        return _eager_reducescatter(x, op, axes)
+        return _eager_recorded("reducescatter",
+                               lambda: _eager_reducescatter(x, op, axes),
+                               x, nbytes)
+    _flightrec.collective_enter("reducescatter", x, nbytes=nbytes,
+                                mode="trace")
     out = x
     for a in axes:
         out = lax.psum_scatter(out, a, scatter_dimension=0, tiled=True)
@@ -180,9 +220,13 @@ def alltoall(x, axes=None):
     axis slowest — chunk i goes to the shard whose ``mesh_rank`` is i,
     matching every other collective's rank ordering."""
     axes = _resolve_axes(axes)
-    _tele.record_collective("alltoall", _wire_bytes(x))
+    nbytes = _wire_bytes(x)
+    _tele.record_collective("alltoall", nbytes)
     if not _in_named_context(axes):
-        return _eager_alltoall(x, axes)
+        return _eager_recorded("alltoall",
+                               lambda: _eager_alltoall(x, axes),
+                               x, nbytes)
+    _flightrec.collective_enter("alltoall", x, nbytes=nbytes, mode="trace")
     return lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)
 
 

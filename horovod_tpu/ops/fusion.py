@@ -160,6 +160,8 @@ def _timeline_mark(kind, idx, nbytes):
     stashes its id on the timeline), the marker joins it — linking the
     dispatch slice to the bucket collectives it scheduled."""
     from horovod_tpu import basics
+    from horovod_tpu.diag import recorder as _flightrec
+    _flightrec.record_event("bucket", kind=kind, idx=idx, nbytes=nbytes)
     tl = basics._state.timeline
     if tl is not None:
         tl.bucket_marker(kind, idx, nbytes,
@@ -276,16 +278,21 @@ def fused_allreduce(tree, op=collective.Average, axes=None,
 class AutotuneTimings(dict):
     """``{threshold_bytes: seconds}`` from :func:`autotune_fusion_threshold`
     plus ``retried`` — how many candidate trials hit an inverted slope
-    window and were re-measured with doubled iters. A nonzero count means
-    the trial lengths were near the noise floor for this workload."""
+    window and were re-measured with doubled iters (a nonzero count means
+    the trial lengths were near the noise floor for this workload) — and
+    ``abstain_reason``: when the tuner returned ``(None, timings)``
+    instead of a winner, the human-readable reason why the trials carried
+    no rankable signal (docs/AUTOTUNE.md, "When the tuner abstains")."""
 
-    def __init__(self, *args, retried=0, **kwargs):
+    def __init__(self, *args, retried=0, abstain_reason=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.retried = retried
+        self.abstain_reason = abstain_reason
 
 
 def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
-                              candidates=None, trials=10, apply=True):
+                              candidates=None, trials=10, apply=True,
+                              tolerance=0.10):
     """Pick the fusion bucket threshold by timed trials at init.
 
     The compiled-path analogue of the reference autotuner's
@@ -310,6 +317,18 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
     slope window and were re-run with doubled iters (ranking candidates on
     an inverted window's full-window upper bound would compare fixed
     dispatch costs, not bucket plans — BENCH_r05 tail, VERDICT r5 #2).
+
+    **Abstention (no-signal contract, docs/AUTOTUNE.md):** the tuner
+    returns ``(None, timings)`` — installing nothing, with
+    ``timings.abstain_reason`` set — instead of publishing a fake winner
+    when the trials cannot rank candidates:
+
+    * the world size over ``axes`` is 1 (the collectives are no-ops;
+      every "timing" is pure dispatch noise), or
+    * after retries some candidate is still an unresolved upper BOUND
+      (``WindowTime.upper_bound``) within ``tolerance`` of the argmin —
+      its true time could be anywhere at or below the bound, so the
+      argmin is not trustworthy.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -324,6 +343,23 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
     except RuntimeError:
         mesh = None
     axes_t = collective._resolve_axes(axes) if mesh is not None else axes
+
+    # world size over the reduction axes: mesh participants on the
+    # compiled path; on the eager fallback the participant set is the
+    # native core's world when it is up (hvdrun multi-process without
+    # jax.distributed — jax.process_count() is 1 per process there),
+    # else the jax process count
+    if mesh is not None:
+        shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+        world = int(np.prod([shape[a] for a in axes_t]))
+    else:
+        from horovod_tpu import _core as _core_probe
+        world = (_core_probe.size() if _core_probe.is_initialized()
+                 else jax.process_count())
+    if world <= 1:
+        return None, AutotuneTimings(abstain_reason=(
+            f"world size 1 over axes {axes_t!r}: the fused collectives "
+            "are local no-ops, so threshold timings carry no signal"))
 
     timings = AutotuneTimings()
     for thr in candidates:
@@ -368,23 +404,47 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
         # normalize retried trials back to seconds-per-`trials`-iters so
         # candidates stay comparable under argmin
         timings[thr] = WindowTime(float(dt) * trials / iters,
-                                  upper_bound=dt.upper_bound)
+                                  upper_bound=dt.upper_bound,
+                                  asymmetric=dt.asymmetric)
 
     # Multi-process: every rank must install the SAME winner, or ranks
     # would plan different bucket structures and emit mismatched
     # collectives. Sum the timings across ranks, then argmin — a
-    # deterministic, globally identical choice.
+    # deterministic, globally identical choice. The upper-bound flags
+    # ride along (max across ranks) so the abstain decision below is
+    # identical everywhere too.
     from horovod_tpu import _core
     if _core.is_initialized() and _core.size() > 1:
-        vals = np.asarray([timings[c] for c in candidates], np.float64)
+        vals = np.asarray(
+            [timings[c] for c in candidates]
+            + [float(getattr(timings[c], "upper_bound", False))
+               for c in candidates], np.float64)
         n = _AUTOTUNE_CALLS.setdefault("n", 0)
         _AUTOTUNE_CALLS["n"] = n + 1
         summed = _core.allreduce(vals, f"autotune.fusion.{n}", op="sum")
         timings = AutotuneTimings(
-            {c: float(s) for c, s in zip(candidates, summed)},
+            {c: WindowTime(float(s), upper_bound=bool(b > 0))
+             for c, s, b in zip(candidates, summed,
+                                summed[len(candidates):])},
             retried=timings.retried)
 
     best = min(timings, key=timings.get)
+    best_val = float(timings[best])
+    # Abstain on unresolved bounds near the argmin: an upper BOUND only
+    # says "the true time is <= this", so any bounded candidate within
+    # `tolerance` of (or below) the best value could secretly be the
+    # winner — publishing an argmin over it would install noise.
+    unresolved = sorted(
+        c for c in candidates
+        if getattr(timings[c], "upper_bound", False)
+        and float(timings[c]) <= best_val * (1.0 + tolerance))
+    if unresolved:
+        timings.abstain_reason = (
+            f"candidate(s) {[c >> 20 for c in unresolved]} MB are still "
+            f"inverted-window upper bounds within {tolerance:.0%} of the "
+            "best measured time after retries; the argmin would rank "
+            "noise — keeping the current default")
+        return None, timings
     if apply and basics._state.config is not None:
         basics._state.config.fusion_threshold = best
     return best, timings

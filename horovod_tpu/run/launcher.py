@@ -234,6 +234,12 @@ def launch(slots, command, controller_addr, controller_port,
     job = Job()
     if rendezvous_port is not None and rendezvous_addr is None:
         rendezvous_addr = launcher_addr(slots)
+    if output_dir and not (extra_env or {}).get("HOROVOD_FLIGHTREC_DIR"):
+        # flight-recorder dumps belong next to the per-rank logs they
+        # explain (elastic epochs get per-epoch dirs for free); the
+        # hvdrun CLI only pre-sets the var when there is NO output dir
+        extra_env = dict(extra_env or {})
+        extra_env["HOROVOD_FLIGHTREC_DIR"] = output_dir
     for slot in slots:
         env = slot_env(slot, controller_addr, controller_port,
                        rendezvous_addr=rendezvous_addr,

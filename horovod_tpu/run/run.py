@@ -159,6 +159,16 @@ def build_parser():
                       choices=["trace", "debug", "info", "warning",
                                "error", "fatal"])
 
+    p.add_argument("--doctor", metavar="LOGDIR", default=None,
+                   help="aggregate the flight-recorder dumps "
+                        "(flightrec.rank*.json) under LOGDIR into one "
+                        "hang/crash report (dead ranks, last common "
+                        "collective_seq, the collective each straggler "
+                        "is parked in, probable cause), then exit — same "
+                        "as python -m horovod_tpu.diag.doctor")
+    p.add_argument("--no-doctor", action="store_true",
+                   help="do not auto-run the doctor when a job exits "
+                        "non-zero with flight-recorder dumps present")
     p.add_argument("--merge-timeline", metavar="OUT", default=None,
                    help="merge per-rank Chrome trace files into one "
                         "Perfetto-loadable trace with aligned clocks and "
@@ -181,7 +191,8 @@ def parse_args(argv=None):
     args.elastic = _validate_elastic_args(parser, args)
     # after the config overlay: the YAML may supply num-proc
     if (not args.check_build and not args.elastic
-            and args.merge_timeline is None and args.num_proc is None):
+            and args.merge_timeline is None and args.doctor is None
+            and args.num_proc is None):
         parser.error("-np/--num-proc is required")
     return args
 
@@ -381,6 +392,61 @@ def _base_worker_env(args, auth_key, all_local, hosts, rendezvous_port):
     return extra_env
 
 
+def _flightrec_dir(args, extra_env):
+    """Where this run's flight-recorder dumps land (diag/): the
+    --output-dir when given (launcher.launch plumbs it next to the rank
+    logs), an explicitly exported HOROVOD_FLIGHTREC_DIR, else a
+    run-scoped temp dir so the post-failure auto-doctor always has a
+    place to look. Returns ``(dir, created_tmp_dir_or_None)``."""
+    import tempfile
+    if args.output_dir:
+        return args.output_dir, None
+    explicit = os.environ.get("HOROVOD_FLIGHTREC_DIR")
+    if explicit:
+        return explicit, None
+    d = tempfile.mkdtemp(prefix="hvdrun_flightrec_")
+    extra_env["HOROVOD_FLIGHTREC_DIR"] = d
+    return d, d
+
+
+def _maybe_doctor(args, dump_dir, multi_host=False):
+    """Auto-run the desync doctor over this run's dumps after a failed
+    job (opt out: --no-doctor): the report that names the dead rank and
+    the collective the survivors are parked in, printed right where the
+    operator is already looking. ``multi_host`` jobs only have the
+    launcher host's dumps visible here, so missing ranks must not be
+    read as dead — the caveat is printed and the no-dump verdict is
+    left to an explicit doctor run over the collected dumps."""
+    if getattr(args, "no_doctor", False) or not dump_dir:
+        return
+    try:
+        from horovod_tpu.diag import doctor as doctor_mod
+        if not doctor_mod.find_dumps(dump_dir):
+            return
+        print(f"hvdrun: flight-recorder dumps found in {dump_dir}; "
+              "doctor report (suppress with --no-doctor):",
+              file=sys.stderr)
+        if multi_host:
+            print("hvdrun: MULTI-HOST job — only this host's dumps are "
+                  "visible below; ranks on other hosts may be wrongly "
+                  "listed as DEAD. Collect each host's dump dir into one "
+                  "place and rerun `hvdrun --doctor <dir>` for the real "
+                  "verdict.", file=sys.stderr)
+        doctor_mod.run(dump_dir, expected_size=args.num_proc,
+                       stream=sys.stderr)
+    except Exception as e:  # the report must never mask the real failure
+        print(f"hvdrun: doctor failed: {e}", file=sys.stderr)
+
+
+def _cleanup_tmp_flightrec(tmp_dir):
+    """A clean run's temp dump dir (clean-exit dumps only) is noise —
+    remove it; failed runs keep theirs (the doctor names the path)."""
+    if not tmp_dir:
+        return
+    import shutil
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+
+
 def _run(args):
     if not args.command:
         raise SystemExit("hvdrun: no training command given")
@@ -411,6 +477,7 @@ def _run(args):
         extra_env["HOROVOD_COORDINATOR_ADDR"] = f"{controller_addr}:{jport}"
 
     _check_metrics_ports(args, slots)
+    dump_dir, tmp_dump_dir = _flightrec_dir(args, extra_env)
     if args.verbose:
         print(f"hvdrun: launching {args.num_proc} processes: "
               f"{[ (s.rank, s.hostname, s.local_rank) for s in slots ]}",
@@ -421,6 +488,10 @@ def _run(args):
                           output_dir=args.output_dir)
     try:
         job.wait()
+        _cleanup_tmp_flightrec(tmp_dump_dir)
+    except RuntimeError:
+        _maybe_doctor(args, dump_dir, multi_host=not all_local)
+        raise
     finally:
         kv.stop()
 
@@ -470,11 +541,16 @@ def _run_elastic(args):
         rendezvous_port=rendezvous_port, extra_env=extra_env,
         ssh_port=args.ssh_port, output_dir=args.output_dir,
         jax_coordinator=args.jax_coordinator)
+    dump_dir, tmp_dump_dir = _flightrec_dir(args, extra_env)
     try:
         epochs = driver.run_job(launch)
         if args.verbose:
             print(f"hvdrun: elastic job completed after {epochs} epoch(s)",
                   file=sys.stderr)
+        _cleanup_tmp_flightrec(tmp_dump_dir)
+    except (RuntimeError, TimeoutError):
+        _maybe_doctor(args, dump_dir, multi_host=not all_local)
+        raise
     finally:
         driver.stop()
         kv.stop()
@@ -485,6 +561,12 @@ def main(argv=None):
     if args.check_build:
         check_build()
         return 0
+    if args.doctor is not None:
+        from horovod_tpu.diag import doctor as doctor_mod
+        argv_d = [args.doctor]
+        if args.num_proc:
+            argv_d += ["--expected-size", str(args.num_proc)]
+        return doctor_mod.main(argv_d)
     if args.merge_timeline is not None:
         from horovod_tpu.telemetry import merge as merge_mod
         traces = [c for c in args.command if c != "--"]

@@ -54,6 +54,17 @@ def _rank_timeline_path(path, rank, size):
 def start(state):
     cfg = state.config
     native_core = bool(cfg.controller_addr and cfg.size > 1)
+    # flight recorder first: the black box must be armed before the
+    # services whose failures it is meant to explain (controller
+    # handshake, mesh build) can crash the process
+    if cfg.flightrec_enabled:
+        from horovod_tpu import diag
+        state.flight_recorder = diag.install(
+            capacity=cfg.flightrec_capacity, dump_dir=cfg.flightrec_dir,
+            rank=cfg.rank, size=cfg.size, config=cfg)
+        logger.info("flight recorder armed (capacity %d) -> %s",
+                    cfg.flightrec_capacity,
+                    state.flight_recorder.dump_path())
     # every rank writes its own host trace (pid = rank) so the telemetry
     # merge tool can build one cross-rank view; the native core's C++
     # timeline additionally records rank 0's negotiation plane at the
@@ -155,3 +166,9 @@ def stop(state):
     if state.timeline is not None:
         state.timeline.close()
         state.timeline = None
+    if state.flight_recorder is not None:
+        # final dump on the clean path: "dump with a shutdown reason"
+        # is how the doctor tells a clean exit from a hard kill
+        from horovod_tpu import diag
+        diag.uninstall(dump=True, reason="shutdown")
+        state.flight_recorder = None

@@ -103,6 +103,18 @@ class StallInspector:
                 "progress)%s. Check that all ranks are submitting steps.",
                 idle, names)
             self._warned = True
+            # the stall warning IS a dump trigger: the flight recorder
+            # must hit disk while the evidence (which collective we are
+            # parked in) is still in the ring — a later SIGKILL leaves
+            # nothing (horovod_tpu.diag)
+            try:
+                from horovod_tpu.diag import recorder as _flightrec
+                _flightrec.record_event("stall", idle_s=round(idle, 3),
+                                        stalled=sorted(stalled))
+                _flightrec.dump_now("stall")
+            except Exception:
+                logger.debug("stall flight-recorder dump failed",
+                             exc_info=True)
         if (self._shutdown_time > 0 and idle > self._shutdown_time
                 and not self.shutdown_requested):
             logger.error(

@@ -1,11 +1,14 @@
 """The per-rank HTTP observability plane (stdlib only).
 
-Three endpoints, served from a daemon ``ThreadingHTTPServer`` that
+Four endpoints, served from a daemon ``ThreadingHTTPServer`` that
 ``runtime/services.py`` starts alongside the controller/stall services
 when ``HOROVOD_METRICS_PORT`` is configured:
 
 * ``GET /metrics``  — the registry in Prometheus text format,
 * ``GET /healthz``  — liveness JSON (rank identity + step progress),
+* ``GET /flightrec`` — the flight recorder's current ring as JSON
+  (``horovod_tpu.diag``); ``?dump=1`` also writes the on-disk
+  ``flightrec.rank<r>.json`` — the on-demand black-box pull,
 * ``GET /profile?seconds=N`` — on-demand ``jax.profiler`` device trace:
   starts a capture into ``HOROVOD_PROFILE_DIR`` (default
   ``/tmp/horovod_tpu_profile``), stops it after N seconds on a worker
@@ -114,6 +117,23 @@ class MetricsServer:
                             health.update(server._health_fn() or {})
                         self._respond(200, json.dumps(health),
                                       "application/json")
+                    elif url.path == "/flightrec":
+                        from horovod_tpu.diag import recorder as flightrec
+                        rec = flightrec.get_recorder()
+                        if rec is None:
+                            self._respond(404, json.dumps(
+                                {"error": "no flight recorder installed "
+                                          "(HOROVOD_FLIGHTREC)"}),
+                                "application/json")
+                        else:
+                            q = parse_qs(url.query)
+                            # ?dump=1 additionally writes the on-disk
+                            # flightrec.rank<r>.json (on-demand black box)
+                            if q.get("dump", ["0"])[0] not in ("0", ""):
+                                rec.dump(reason="endpoint")
+                            self._respond(
+                                200, json.dumps(rec.snapshot()),
+                                "application/json")
                     elif url.path == "/profile":
                         q = parse_qs(url.query)
                         seconds = float(q.get("seconds", ["3"])[0])

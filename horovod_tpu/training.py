@@ -332,10 +332,23 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     def place_state(state):
         return _placer(mesh, state_specs(state))(state)
 
+    from horovod_tpu.diag import recorder as _flightrec
+
     if not tele_on:
+        _step_no = [0]
+
         def step(state, inputs, labels):
-            return jitted(place_state(state), place_data(inputs),
-                          place_data(labels))
+            # flight-recorder step boundaries (host-side only: with no
+            # recorder installed these are a None check each, and they
+            # never touch the traced computation — the compiled program
+            # stays byte-identical either way, tests/test_diag.py)
+            n = _step_no[0]
+            _step_no[0] = n + 1
+            _flightrec.step_begin(n)
+            out = jitted(place_state(state), place_data(inputs),
+                         place_data(labels))
+            _flightrec.step_end(n)
+            return out
     else:
         from horovod_tpu import basics as _basics
         import time as _time
@@ -344,6 +357,8 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
         first_trace = [True]
 
         def step(state, inputs, labels):
+            step_no = int(instruments.steps.value)
+            _flightrec.step_begin(step_no)
             tl = _basics._state.timeline
             flow = None
             if tl is not None and first_trace[0]:
@@ -366,6 +381,7 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                     tl._step_flow_id = None
                     tl.flow_end("step_dispatch", flow)
                     tl.end_activity("marker")
+            _flightrec.step_end(step_no)
             instruments.record_step(
                 batch=int(inputs.shape[0]),
                 dispatch_s=_time.perf_counter() - t0,
@@ -528,8 +544,16 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
     def place_state(state):
         return _placer(mesh, state_specs(state))(state)
 
+    from horovod_tpu.diag import recorder as _flightrec
+    _step_no = [0]
+
     def step(state, tokens):
-        return jitted(place_state(state), place_tokens(tokens))
+        n = _step_no[0]
+        _step_no[0] = n + 1
+        _flightrec.step_begin(n)
+        out = jitted(place_state(state), place_tokens(tokens))
+        _flightrec.step_end(n)
+        return out
 
     step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
 

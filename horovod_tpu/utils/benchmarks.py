@@ -60,43 +60,82 @@ class WindowTime(float):
     """A ``slope_window`` duration. ``upper_bound`` is True when the
     inverted-window fallback reported the FULL window time (fixed costs
     included) instead of a slope difference — a conservative bound, not
-    a measurement. Callers that publish medians can count these so
-    bound samples are distinguishable in the reported runs."""
+    a measurement. ``asymmetric`` is True when the per-iteration rates
+    implied by the two window segments disagreed beyond tolerance — a
+    fixed cost attached itself to SOME window lengths but not others, so
+    the slope may be deflated/inflated rather than clean. Callers that
+    publish medians can count either flag so suspect samples are
+    distinguishable in the reported runs."""
 
     upper_bound = False
+    asymmetric = False
 
-    def __new__(cls, value, upper_bound=False):
+    def __new__(cls, value, upper_bound=False, asymmetric=False):
         obj = super().__new__(cls, value)
         obj.upper_bound = upper_bound
+        obj.asymmetric = asymmetric
         return obj
 
 
-def slope_window(step_once, state, iters, base_iters=2):
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    mid = n // 2
+    return xs[mid] if n % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+
+def slope_window(step_once, state, iters, base_iters=2, rounds=3,
+                 rate_tolerance=0.5):
     """THE timing primitive (one copy — every bench path uses it).
 
-    Times ``iters`` iterations by the slope method: run a short
-    ``base_iters`` window and a ``base_iters + iters`` window, each
-    terminated by a forced readback (``sync``), and return their
-    difference. The readback guarantees real completion and its ~100 ms
-    tunnel cost — like every other fixed dispatch cost — cancels in the
-    difference.
+    Times ``iters`` iterations by the slope method, hardened with
+    interleaved windows: each of ``rounds`` rounds runs a *base* window
+    (``base_iters`` iterations), a *mid* window (``base_iters + h``,
+    ``h = iters // 2``) and a *full* window (``base_iters + iters``),
+    each terminated by a forced readback (``sync``). Every
+    (shorter, longer) window pair within a round yields a pairwise
+    per-iteration slope; the reported duration is the MEDIAN pairwise
+    slope times ``iters``. The readback guarantees real completion and
+    its ~100 ms tunnel cost — like every other fixed dispatch cost —
+    cancels in each difference; the median across interleaved rounds
+    keeps any one polluted window (GC pause, CI neighbor, async residue
+    draining late) from owning the result the way the old single
+    base/full pair let it (the reproducible
+    ``test_slope_window_measures_per_iteration_cost`` suite failure —
+    VERDICT r5 Weak #1).
+
+    Asymmetric fixed-cost detection: with three window lengths the
+    per-iteration rate is implied twice over disjoint segments —
+    ``(t_mid - t_base) / h`` and ``(t_full - t_mid) / (iters - h)``. A
+    fixed cost that cancels symmetrically leaves the two medians equal;
+    one that attaches to some window lengths only (partial constant
+    folding, length-dependent re-dispatch) deflates one segment and
+    inflates the other. When the medians disagree by more than
+    ``rate_tolerance`` x the overall rate (and by a material absolute
+    amount — clock granularity on near-zero work does not count), the
+    result is flagged
+    ``asymmetric`` (and a warning names the two rates) — the sample is
+    still the best available estimate, but it is not a clean slope.
 
     ``step_once(state) -> (state, syncable)`` advances ONE iteration and
     must thread state so no two calls see identical inputs (the tunnel
     memoizes pure calls on repeated inputs — BENCH_NOTES.md).
     Returns ``(dt_for_iters, state)``; the duration is a ``WindowTime``
-    whose ``upper_bound`` flag marks the inverted-window fallback.
+    whose ``upper_bound``/``asymmetric`` flags mark the fallback and
+    suspect cases.
 
     Before the timed windows, ONE untimed flush iteration runs and is
-    synced: the base window is a single short measurement, so any one-time
-    cost left pending by earlier work in the process (deferred autotune/
-    warm-up executables draining through the async tunnel, a first-touch
-    compile) would land in it and DEFLATE the slope while passing as a
-    clean measurement — a 10 ms/iter step measured 0.0127 s for 5 iters
-    with ``upper_bound=False`` when run right after the fusion autotuner
+    synced: any one-time cost left pending by earlier work in the
+    process (deferred autotune/warm-up executables draining through the
+    async tunnel, a first-touch compile) would land in the first short
+    window and DEFLATE its slopes while passing as a clean measurement —
+    a 10 ms/iter step measured 0.0127 s for 5 iters with
+    ``upper_bound=False`` when run right after the fusion autotuner
     (VERDICT r5 "sharpest finding"). The flush pins that residue outside
-    both windows.
+    every timed window.
     """
+    import warnings
+
     def window(k, st):
         out = None
         t0 = time.perf_counter()
@@ -105,27 +144,63 @@ def slope_window(step_once, state, iters, base_iters=2):
         sync(out)
         return time.perf_counter() - t0, st
 
+    h = iters // 2
+    lengths = ([base_iters, base_iters + h, base_iters + iters]
+               if 0 < h < iters else [base_iters, base_iters + iters])
+
+    def measure(st):
+        slopes, seg_lo, seg_hi, fulls = [], [], [], []
+        for _ in range(max(1, rounds)):
+            times = []
+            for k in lengths:
+                t, st = window(k, st)
+                times.append(t)
+            fulls.append(times[-1])
+            for i in range(len(lengths)):
+                for j in range(i + 1, len(lengths)):
+                    slopes.append((times[j] - times[i])
+                                  / (lengths[j] - lengths[i]))
+            if len(lengths) == 3:
+                seg_lo.append((times[1] - times[0]) / h)
+                seg_hi.append((times[2] - times[1]) / (iters - h))
+        return slopes, seg_lo, seg_hi, fulls, st
+
     _, state = window(1, state)  # untimed flush: absorb one-time residue
-    t_base, state = window(base_iters, state)
-    t_full, state = window(base_iters + iters, state)
-    if t_full <= t_base:
+    slopes, seg_lo, seg_hi, fulls, state = measure(state)
+    per_iter = _median(slopes)
+    if per_iter <= 0:
         # jitter inversion (fixed-cost noise exceeded the work): retry
-        # once, then fall back to the FULL window time — an upper bound
-        # including fixed costs, so the published rate can only be
-        # conservative. (Clamping the difference would publish an
-        # absurd multi-billion-rate sample; raising would turn tiny
-        # smoke runs on loaded CI machines into flaky failures.)
-        t_base, state = window(base_iters, state)
-        t_full, state = window(base_iters + iters, state)
-        if t_full <= t_base:
-            import warnings
+        # one full interleaved set, then fall back to the median FULL
+        # window time — an upper bound including fixed costs, so the
+        # published rate can only be conservative. (Clamping the slope
+        # would publish an absurd multi-billion-rate sample; raising
+        # would turn tiny smoke runs on loaded CI machines into flaky
+        # failures.)
+        slopes, seg_lo, seg_hi, fulls, state = measure(state)
+        per_iter = _median(slopes)
+        if per_iter <= 0:
+            bound = _median(fulls)
             warnings.warn(
-                f"slope window inverted twice (base {t_base:.4f}s >= "
-                f"full {t_full:.4f}s over {iters} iters); reporting the "
-                f"full-window upper bound — increase iters for a real "
-                f"measurement", stacklevel=2)
-            return WindowTime(t_full, upper_bound=True), state
-    return WindowTime(t_full - t_base), state
+                f"slope window inverted twice (median pairwise slope "
+                f"{per_iter:.6f}s/iter over {iters} iters); reporting "
+                f"the full-window upper bound — increase iters for a "
+                f"real measurement", stacklevel=2)
+            return WindowTime(bound, upper_bound=True), state
+    asymmetric = False
+    if seg_lo and seg_hi:
+        lo, hi = _median(seg_lo), _median(seg_hi)
+        # relative disagreement AND a material absolute amount (clock
+        # granularity on near-zero work is not an asymmetric fixed cost)
+        if (abs(hi - lo) > rate_tolerance * max(per_iter, 1e-12)
+                and abs(hi - lo) * iters > 1e-4):
+            asymmetric = True
+            warnings.warn(
+                f"slope window segments imply different per-iteration "
+                f"rates ({lo:.6f}s vs {hi:.6f}s per iter, median "
+                f"{per_iter:.6f}s): a fixed cost is attaching "
+                f"asymmetrically to window lengths; treat this sample "
+                f"as suspect", stacklevel=2)
+    return WindowTime(per_iter * iters, asymmetric=asymmetric), state
 
 
 def repeat_throughput(step, state, images, labels, warmup, iters,

@@ -1,0 +1,458 @@
+"""Flight recorder + desync doctor (horovod_tpu/diag/).
+
+Unit suite on fake clocks (no sleeps): ring-buffer wraparound, dump
+idempotency under double signals, desync-digest divergence; the doctor's
+probable-cause classifications from synthesized dumps; the /flightrec
+telemetry endpoint; the byte-identical-compiled-program guarantee; and a
+tier-1-safe 2-rank CPU round-trip smoke (recorder -> dump -> doctor).
+The dead-rank end-to-end (SIGKILL mid-collective under hvdrun) lives
+with the other failure-injection tests in tests/test_launcher.py.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd_api
+from horovod_tpu import training
+from horovod_tpu.diag import desync, doctor
+from horovod_tpu.diag import recorder as recorder_mod
+from horovod_tpu.diag.recorder import FlightRecorder
+from horovod_tpu.models.simple import MLP
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def make_recorder(tmp_path, rank=0, size=2, capacity=64, t0=50.0):
+    clk = FakeClock(t0)
+    rec = FlightRecorder(capacity=capacity, rank=rank, size=size,
+                         dump_dir=str(tmp_path), clock=clk,
+                         wall_clock=lambda: clk.t + 1.7e9)
+    return rec, clk
+
+
+def drive_schedule(rec, clk, ops, complete=True):
+    """Enter (and optionally exit) one collective per (op, shape) pair."""
+    for op, shape in ops:
+        clk.advance(0.01)
+        seq = rec.collective_enter(op, name=None, shape=shape,
+                                   dtype="float32",
+                                   nbytes=int(np.prod(shape)) * 4)
+        if complete:
+            clk.advance(0.01)
+            rec.collective_exit(op, seq)
+    return rec
+
+
+# ---- ring buffer ---------------------------------------------------------
+
+def test_ring_buffer_wraparound(tmp_path):
+    rec, clk = make_recorder(tmp_path, capacity=8)
+    drive_schedule(rec, clk, [("allreduce", (4,))] * 20)
+    events = rec.snapshot()["events"]
+    assert len(events) == 8  # bounded forever
+    # counters and digest survive the wrap even though events rolled off
+    assert rec.collective_seq == 20
+    assert rec.last_completed_seq == 20
+    assert rec.snapshot()["events_total"] == 1 + 40  # start + 20 B/E pairs
+    # the newest events are the ones kept
+    seqs = [ev["seq"] for ev in events if ev["k"] == "coll"]
+    assert max(seqs) == 20
+
+
+def test_digest_history_bounded_and_published_compact(tmp_path):
+    rec, clk = make_recorder(tmp_path, capacity=16)
+    drive_schedule(rec, clk, [("allreduce", (4,))] * 300)
+    d = rec.digest()
+    assert d["seq"] == 300
+    assert len(d["hist"]) <= recorder_mod.DIGEST_PUBLISH
+    # history pairs are (seq, hash) with the newest last
+    assert d["hist"][-1][0] == 300
+
+
+# ---- dumps ---------------------------------------------------------------
+
+def test_dump_idempotent_under_double_signal(tmp_path):
+    """Two dump triggers racing (launcher SIGTERM + middleman SIGTERM is
+    the common double) must both leave a complete, parseable file, with
+    the reason history accumulating."""
+    rec, clk = make_recorder(tmp_path, rank=3, size=4)
+    drive_schedule(rec, clk, [("allreduce", (8,))] * 3, complete=False)
+    p1 = rec.dump(reason="signal:15")
+    with open(p1) as f:
+        first = json.load(f)
+    p2 = rec.dump(reason="signal:15")
+    assert p1 == p2 == rec.dump_path()
+    with open(p2) as f:
+        second = json.load(f)
+    assert first["rank"] == second["rank"] == 3
+    assert second["dump_reasons"] == ["signal:15", "signal:15"]
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    # re-entrant call while the lock is held is skipped, never torn
+    rec._dump_lock.acquire()
+    try:
+        assert rec.dump(reason="signal:15") is None
+    finally:
+        rec._dump_lock.release()
+
+
+def test_dump_survives_mid_run_and_final(tmp_path):
+    """A stall-triggered dump followed by a crash dump: the final file
+    wins and holds the full reason history (the doctor reads one file
+    per rank)."""
+    rec, clk = make_recorder(tmp_path)
+    drive_schedule(rec, clk, [("allreduce", (4,))] * 2)
+    rec.dump(reason="stall")
+    drive_schedule(rec, clk, [("allgather", (2,))], complete=False)
+    rec.dump(reason="exception")
+    with open(rec.dump_path()) as f:
+        d = json.load(f)
+    assert d["dump_reasons"] == ["stall", "exception"]
+    assert d["open_collectives"] == {"3": "allgather"}
+
+
+# ---- desync digests ------------------------------------------------------
+
+def test_desync_divergence_names_minority_rank(tmp_path):
+    shared = [("allreduce", (4,)), ("allgather", (2,)), ("allreduce", (4,))]
+    recs = {}
+    for r in range(3):
+        rec, clk = make_recorder(tmp_path, rank=r, size=3)
+        drive_schedule(rec, clk, shared)
+        recs[r] = (rec, clk)
+    # rank 1 diverges (different op at seq 4); 0 and 2 stay in lockstep
+    drive_schedule(*recs[0], [("allreduce", (8,))])
+    drive_schedule(*recs[1], [("broadcast", (8,))])
+    drive_schedule(*recs[2], [("allreduce", (8,))])
+    check = desync.cross_check({r: rec.digest()
+                                for r, (rec, _c) in recs.items()})
+    assert check["desynced"] == [1]
+    assert check["last_common_seq"] == 4
+    assert "diverged at seq 4" in check["detail"]
+
+
+def test_desync_same_schedule_is_clean(tmp_path):
+    ops = [("allreduce", (4,)), ("reducescatter", (8,))]
+    digests = {}
+    for r in range(2):
+        rec, clk = make_recorder(tmp_path, rank=r)
+        drive_schedule(rec, clk, ops)
+        digests[r] = rec.digest()
+    check = desync.cross_check(digests)
+    assert check["desynced"] == []
+    assert check["last_common_seq"] == 2
+
+
+def test_ragged_allgather_does_not_fork_digest(tmp_path):
+    """Eager allgather carries allgatherv semantics: per-rank first dims
+    may legitimately differ, so the shape must stay out of the schedule
+    digest — a ragged (but correct) allgather is NOT a desync."""
+    digests = {}
+    for r, rows in ((0, 3), (1, 5)):
+        rec, clk = make_recorder(tmp_path, rank=r)
+        s = rec.collective_enter("allgather", shape=(rows, 2),
+                                 dtype="float32", hash_shape=False)
+        rec.collective_exit("allgather", s)
+        s = rec.collective_enter("allreduce", shape=(4,), dtype="float32")
+        rec.collective_exit("allreduce", s)
+        digests[r] = rec.digest()
+    check = desync.cross_check(digests)
+    assert check["desynced"] == []
+    assert check["last_common_seq"] == 2
+
+
+def test_desync_stuck_rank_detection(tmp_path):
+    recs = {}
+    for r in range(2):
+        rec, clk = make_recorder(tmp_path, rank=r)
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 3)
+        recs[r] = (rec, clk)
+    prev = {r: rec.digest() for r, (rec, _c) in recs.items()}
+    drive_schedule(*recs[0], [("allreduce", (4,))] * 2)  # rank 1 frozen
+    now = {r: rec.digest() for r, (rec, _c) in recs.items()}
+    check = desync.cross_check(now, prev=prev)
+    assert check["stuck"] == [1]
+    assert check["desynced"] == []  # same schedule, just not advancing
+
+
+# ---- doctor --------------------------------------------------------------
+
+def _dump_ranks(tmp_path, specs):
+    """specs: {rank: fn(rec, clk)} -> dumps loaded back from disk."""
+    for r, fn in specs.items():
+        rec, clk = make_recorder(tmp_path, rank=r,
+                                 size=max(specs) + 1, t0=50.0 + r)
+        fn(rec, clk)
+    dumps, skipped = doctor.load_dumps(str(tmp_path))
+    assert skipped == []
+    return dumps
+
+
+def test_doctor_dead_rank_report(tmp_path):
+    """The acceptance shape: rank 1 of 3 hard-killed, survivors parked —
+    the report names the dead rank, the last common seq and the parked
+    collective, and classifies 'dead rank'."""
+    def survivor(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 4)
+        drive_schedule(rec, clk, [("allreduce", (4,))], complete=False)
+        rec.dump(reason="signal:15")
+
+    dumps = _dump_ranks(tmp_path, {0: survivor, 2: survivor})
+    report = doctor.diagnose(dumps, expected_size=3)
+    assert report["dead_ranks"] == [1]
+    assert report["classification"] == "dead rank"
+    assert report["last_common_seq"] == 4
+    assert report["per_rank"][0]["parked"] == (5, "allreduce")
+    text = doctor.format_report(report)
+    assert "DEAD (no flight-recorder dump): rank(s) 1" in text
+    assert "last common collective_seq: 4" in text
+    assert "PARKED in allreduce (seq 5)" in text
+    assert "probable cause: dead rank" in text
+
+
+def test_doctor_desync_classification(tmp_path):
+    def majority(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,)), ("allreduce", (8,))])
+        rec.dump(reason="stall")
+
+    def minority(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,)), ("allgather", (8,))])
+        rec.dump(reason="stall")
+
+    dumps = _dump_ranks(tmp_path, {0: majority, 1: minority, 2: majority})
+    report = doctor.diagnose(dumps)
+    assert report["classification"] == "desync"
+    assert report["desync"]["desynced"] == [1]
+
+
+def test_doctor_data_stall_classification(tmp_path):
+    def parked(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 3)
+        drive_schedule(rec, clk, [("allreduce", (4,))], complete=False)
+        rec.dump(reason="stall")
+
+    def starved(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 3)
+        rec.step_begin(3)
+        rec.step_end(3)  # finished its step, never fed the next one
+        rec.dump(reason="stall")
+
+    dumps = _dump_ranks(tmp_path, {0: parked, 1: starved})
+    report = doctor.diagnose(dumps)
+    assert report["classification"] == "data stall"
+    assert "1" in report["explanation"]
+
+
+def test_doctor_compile_stall_classification(tmp_path):
+    def parked(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 3)
+        drive_schedule(rec, clk, [("allreduce", (4,))], complete=False)
+        rec.dump(reason="stall")
+
+    def compiling(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 3)
+        rec.step_begin(7)  # entered the step, no collective since
+        rec.dump(reason="stall")
+
+    dumps = _dump_ranks(tmp_path, {0: parked, 1: compiling})
+    report = doctor.diagnose(dumps)
+    assert report["classification"] == "compile stall"
+
+
+def test_doctor_healthy_classification(tmp_path):
+    def clean(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 2)
+        rec.dump(reason="exit")
+
+    dumps = _dump_ranks(tmp_path, {0: clean, 1: clean})
+    report = doctor.diagnose(dumps)
+    assert report["classification"] == "healthy"
+    assert report["dead_ranks"] == []
+
+
+def test_doctor_config_mismatch_flagged(tmp_path):
+    from horovod_tpu.config import Config
+
+    def with_cfg(threshold):
+        def fn(rec, clk):
+            cfg = Config(rank=rec.rank, size=2,
+                         fusion_threshold=threshold)
+            rec.config_snapshot = {"fusion_threshold": threshold}
+            rec.config_crc = recorder_mod.config_fingerprint(cfg)
+            drive_schedule(rec, clk, [("allreduce", (4,))])
+            rec.dump(reason="exit")
+        return fn
+
+    dumps = _dump_ranks(tmp_path, {0: with_cfg(1 << 20),
+                                   1: with_cfg(64 << 20)})
+    report = doctor.diagnose(dumps)
+    assert report["config_mismatch"] is not None
+    assert "CONFIG MISMATCH" in doctor.format_report(report)
+
+
+def test_doctor_cli_module(tmp_path, capsys):
+    rec, clk = make_recorder(tmp_path, rank=0, size=1)
+    drive_schedule(rec, clk, [("allreduce", (4,))])
+    rec.dump(reason="exit")
+    assert doctor.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "doctor report" in out
+    assert doctor.main([str(tmp_path / "empty_nothing_here")]) == 2
+
+
+def test_config_fingerprint_ignores_per_rank_identity():
+    from horovod_tpu.config import Config
+    a = recorder_mod.config_fingerprint(Config(rank=0, local_rank=0,
+                                               metrics_port=9090))
+    b = recorder_mod.config_fingerprint(Config(rank=3, local_rank=1,
+                                               metrics_port=9093))
+    assert a == b
+    c = recorder_mod.config_fingerprint(Config(rank=0,
+                                               fusion_threshold=1 << 20))
+    assert a != c
+
+
+# ---- install / uninstall -------------------------------------------------
+
+def test_install_uninstall_restores_hooks(tmp_path):
+    prev_excepthook = sys.excepthook
+    prev_term = signal.getsignal(signal.SIGTERM)
+    rec = recorder_mod.install(capacity=32, dump_dir=str(tmp_path),
+                               rank=0, size=1)
+    try:
+        assert recorder_mod.get_recorder() is rec
+        assert recorder_mod.install() is rec  # idempotent
+        assert sys.excepthook is not prev_excepthook
+        assert signal.getsignal(signal.SIGTERM) is not prev_term
+        seq = recorder_mod.collective_enter("allreduce",
+                                            np.ones((4,), np.float32))
+        assert seq == 1
+        recorder_mod.collective_exit("allreduce", seq)
+        assert recorder_mod.dump_now("on_demand") == rec.dump_path()
+    finally:
+        recorder_mod.uninstall(dump=False)
+    assert recorder_mod.get_recorder() is None
+    assert sys.excepthook is prev_excepthook
+    assert signal.getsignal(signal.SIGTERM) == prev_term
+    # module-level hooks are no-ops again
+    assert recorder_mod.collective_enter("allreduce", None) == 0
+    assert recorder_mod.dump_now() is None
+
+
+# ---- /flightrec endpoint -------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_flightrec_endpoint(tmp_path, monkeypatch):
+    from horovod_tpu.telemetry import MetricsServer
+
+    srv = MetricsServer(port=0)
+    port = srv.start()
+    try:
+        # no recorder installed -> 404 with a hint
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(port, "/flightrec")
+        assert exc.value.code == 404
+
+        rec, clk = make_recorder(tmp_path, rank=5, size=8)
+        drive_schedule(rec, clk, [("allreduce", (16,))] * 2)
+        monkeypatch.setattr(recorder_mod, "_recorder", rec)
+        status, body = _get(port, "/flightrec")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["rank"] == 5 and snap["collective_seq"] == 2
+        assert not os.path.exists(rec.dump_path())  # plain GET: no disk
+        status, _ = _get(port, "/flightrec?dump=1")
+        assert status == 200
+        assert os.path.exists(rec.dump_path())  # ?dump=1 = on-demand dump
+    finally:
+        srv.stop()
+
+
+# ---- byte-identical compiled programs ------------------------------------
+
+def test_compiled_step_byte_identical_with_and_without_recorder(
+        hvd, tmp_path, monkeypatch):
+    """The acceptance bar: the recorder must never shape the traced
+    computation — the lowered train step with a recorder installed is
+    byte-identical to the uninstrumented one (HOROVOD_FLIGHTREC=0)."""
+    def lower_text():
+        model = MLP(features=(8, 2))
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.1))
+        state = training.create_train_state(
+            model, tx, jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+        step = training.make_train_step(model, tx, donate=False,
+                                        telemetry=False)
+        x = jnp.zeros((8, 4), jnp.float32)
+        y = jnp.zeros((8,), jnp.int32)
+        return step.lower(state, x, y).as_text()
+
+    baseline = lower_text()
+    rec, _clk = make_recorder(tmp_path)
+    monkeypatch.setattr(recorder_mod, "_recorder", rec)
+    with_recorder = lower_text()
+    assert with_recorder == baseline
+    # and the recorder actually saw the trace-time dispatches
+    assert rec.collective_seq > 0
+
+
+# ---- 2-rank CPU round-trip smoke (satellite: CI/tooling) -----------------
+
+def test_two_rank_roundtrip_recorder_dump_doctor(tmp_path):
+    """Recorder -> dump -> doctor on a real 2-rank CPU run: a healthy
+    job leaves per-rank dumps whose doctor report classifies 'healthy'
+    (flight recording auto-enables for multi-process jobs)."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        for _ in range(3):
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum)
+        hvd.shutdown()
+    """))
+    out_dir = tmp_path / "out"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rv = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--output-dir", str(out_dir), sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert rv.returncode == 0, rv.stdout + rv.stderr
+    dumps, skipped = doctor.load_dumps(str(out_dir))
+    assert skipped == []
+    assert sorted(dumps) == [0, 1]
+    report = doctor.diagnose(dumps)
+    assert report["classification"] == "healthy"
+    assert report["per_rank"][0]["seq"] >= 3
+    # both ranks dispatched the same schedule: no desync, no stragglers
+    assert report["desync"]["desynced"] == []
+    assert report["last_common_seq"] >= 3

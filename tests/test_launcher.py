@@ -281,6 +281,73 @@ def test_stalled_rank_named_before_death(tmp_path):
     assert "remaining processes were terminated" in rv.stderr
 
 
+def test_sigkilled_rank_diagnosed_by_doctor(tmp_path):
+    """The flight-recorder acceptance path (ISSUE 4 e2e): under
+    ``hvdrun -np 3`` on CPU, SIGKILLing rank 1 mid-step leaves
+    flight-recorder dumps from the survivors; hvdrun auto-runs the
+    doctor, whose report names rank 1 as dead, identifies the last
+    common collective_seq and the collective the survivors are parked
+    in, and classifies the cause as 'dead rank'. A standalone doctor run
+    over the logdir reproduces the same verdict."""
+    from horovod_tpu.diag import doctor
+
+    script = tmp_path / "die.py"
+    script.write_text(textwrap.dedent("""
+        import os, signal, time
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        for step in range(50):
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum)
+            if hvd.rank() == 1 and step == 3:
+                os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, no dump
+        time.sleep(120)
+    """))
+    out_dir = tmp_path / "out"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rv = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "3",
+         "--output-dir", str(out_dir), sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert rv.returncode == 1
+    assert "exited with code 137" in rv.stderr
+    # the auto-doctor report on hvdrun's stderr names the whole story
+    assert "doctor report" in rv.stderr
+    assert "DEAD (no flight-recorder dump): rank(s) 1" in rv.stderr
+    assert "last common collective_seq: 4" in rv.stderr
+    # each survivor either PARKED in the seq-5 allreduce (still waiting)
+    # or saw it FAIL under it when the dead rank's socket dropped —
+    # either way the report names the collective the dead rank missed
+    assert ("PARKED in allreduce (seq 5)" in rv.stderr
+            or "FAILED in allreduce (seq 5)" in rv.stderr)
+    assert "probable cause: dead rank" in rv.stderr
+    # survivors (not the SIGKILLed rank) left dumps next to the rank logs
+    dumps, _skipped = doctor.load_dumps(str(out_dir))
+    assert 1 not in dumps and len(dumps) == 2
+    # the standalone doctor over the logdir reaches the same verdict
+    report = doctor.diagnose(dumps, expected_size=3)
+    assert report["classification"] == "dead rank"
+    assert report["dead_ranks"] == [1]
+    assert report["last_common_seq"] == 4
+    stuck = [i["parked"] or i["failed"]
+             for i in report["per_rank"].values()]
+    assert any(x == (5, "allreduce") for x in stuck)
+
+
+def test_hvdrun_doctor_flag(tmp_path):
+    """hvdrun --doctor <logdir> == python -m horovod_tpu.diag.doctor."""
+    from horovod_tpu.diag.recorder import FlightRecorder
+    rec = FlightRecorder(capacity=8, rank=0, size=1,
+                         dump_dir=str(tmp_path))
+    rec.collective_enter("allreduce", shape=(4,), dtype="float32")
+    rec.dump(reason="exit")
+    from horovod_tpu.run.run import main
+    assert main(["--doctor", str(tmp_path)]) == 0
+    assert main(["--doctor", str(tmp_path / "nope")]) == 2
+
+
 def test_cli_failure_kills_job(tmp_path):
     script = tmp_path / "crash.py"
     script.write_text(textwrap.dedent("""
