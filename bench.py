@@ -231,6 +231,54 @@ def _telemetry_block():
             if k.startswith(keep)}
 
 
+def _checkpoint_block(nbytes=32 << 20):
+    """Async-checkpoint microbench for the BENCH json (docs/
+    CHECKPOINT.md): for a synthetic ``nbytes`` state, the synchronous
+    ``save_sharded`` wall time (the old stall-until-durable cost), the
+    stall the async path actually charges the training thread
+    (snapshot + budget wait), the end-to-end commit latency, and the
+    background serialize+fsync bandwidth. One rank, local disk — the
+    floor a real run's shared filesystem can only raise."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from horovod_tpu.ckpt import AsyncCheckpointer, save_sharded
+    from horovod_tpu.telemetry.registry import MetricsRegistry
+
+    rng = np.random.default_rng(0)
+    leaves = 8
+    tree = {f"p{i}": rng.standard_normal(nbytes // 4 // leaves)
+            .astype(np.float32) for i in range(leaves)}
+    root = tempfile.mkdtemp(prefix="hvd_bench_ckpt_")
+    try:
+        t0 = _time.perf_counter()
+        man = save_sharded(root, 1, tree, rank=0, world=1)
+        sync_s = _time.perf_counter() - t0
+        written = sum(s["bytes"] for s in man["shards"].values())
+
+        ck = AsyncCheckpointer(root, keep=2, rank=0, world=1,
+                               registry=MetricsRegistry())
+        t0 = _time.perf_counter()
+        blocking_s = ck.save(2, tree)
+        ck.flush()
+        total_s = _time.perf_counter() - t0
+        ck.close()
+        bg_s = max(total_s - blocking_s, 1e-9)
+        return {
+            "state_mb": round(nbytes / 2**20, 1),
+            "sync_write_ms": round(sync_s * 1e3, 2),
+            "snapshot_stall_ms": round(blocking_s * 1e3, 2),
+            "commit_latency_ms": round(total_s * 1e3, 2),
+            "background_write_mb_per_s": round(written / 2**20 / bg_s, 1),
+            "blocking_pct_of_sync": round(100 * blocking_s / sync_s, 1),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _flightrec_overhead_ns(n=200_000):
     """Micro-bench the flight recorder's hot-path cost (one collective
     entry: deque append + CRC chain) so a regression in the
@@ -448,6 +496,10 @@ def main():
         result["autotune_error"] = autotune_error
     result["flightrec_overhead_ns_per_event"] = round(
         _flightrec_overhead_ns(), 1)
+    try:
+        result["checkpoint"] = _checkpoint_block()
+    except Exception as e:  # noqa: BLE001 — record, don't die
+        result["checkpoint_error"] = str(e).splitlines()[0][:160]
     result["telemetry"] = _telemetry_block()
     print(json.dumps(result))
 

@@ -47,10 +47,10 @@ def main():
 
     # the whole resume convention in one call: rank 0 restores the newest
     # checkpoint (if any), everyone gets the broadcast step/params/state
-    start, params, state = checkpoint.restore_or_init(
+    start, params, state, meta = checkpoint.restore_or_init(
         args.ckpt_dir, params, state)
     if rank == 0 and start > 0:
-        print(f"resuming from step {start}")
+        print(f"resuming from step {start} (meta={meta})")
 
     @jax.jit
     def loss_and_grad(p):

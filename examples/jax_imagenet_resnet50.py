@@ -86,7 +86,7 @@ def main():
 
     # resume discipline: rank 0 restores the newest checkpoint, the
     # start epoch + params + optimizer state broadcast to everyone
-    start, params, opt_state = checkpoint.restore_or_init(
+    start, params, opt_state, _meta = checkpoint.restore_or_init(
         args.ckpt_dir, params, opt_state)
     if rank == 0 and start > 0:
         print(f"resuming from epoch {start}")

@@ -72,6 +72,7 @@ from horovod_tpu.hvd_jax import (
     join,
 )
 from horovod_tpu import checkpoint
+from horovod_tpu import ckpt
 from horovod_tpu import data
 from horovod_tpu import elastic
 from horovod_tpu import telemetry
@@ -93,5 +94,5 @@ __all__ = [
     "distributed_grad", "distributed_value_and_grad",
     "broadcast_variables", "broadcast_parameters",
     "broadcast_optimizer_state", "allreduce_metrics", "join",
-    "checkpoint", "data", "elastic", "telemetry",
+    "checkpoint", "ckpt", "data", "elastic", "telemetry",
 ]

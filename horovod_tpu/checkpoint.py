@@ -13,8 +13,19 @@ Rebuilds the reference's checkpoint discipline (SURVEY §5.4;
 
 Pytrees are serialized with flax msgpack (TPU-idiomatic: works on any
 params/opt_state tree, jax or numpy arrays); writes are atomic
-(tmp + rename) so a worker killed mid-write never leaves a truncated
-checkpoint behind.
+(tmp + fsync + rename + directory fsync) so a worker killed mid-write
+never leaves a truncated checkpoint behind and a committed one survives
+power loss.
+
+**This module is the legacy/compatibility surface.** The successor is
+``horovod_tpu/ckpt`` (async snapshot-offload saves, per-rank shards,
+two-phase manifest commit, elastic N→M resharded restore — see
+docs/CHECKPOINT.md): new code and the elastic ``JaxState`` persist
+through it. The rank-0 single-file format here stays fully supported
+for small states and for restoring pre-subsystem checkpoints. One
+signature changed: ``restore_or_init`` now returns ``(step, params,
+opt_state, meta)`` — callers unpacking three values must add the
+fourth.
 """
 
 import os
@@ -68,14 +79,38 @@ def write_checkpoint(directory, step, params, opt_state=None, meta=None,
         f.flush()
         os.fsync(f.fileno())
     os.rename(tmp, path)
+    # rename alone only orders metadata in the page cache; the entry is
+    # durable across power loss only once the DIRECTORY is fsynced
+    from horovod_tpu.ckpt.manifest import fsync_dir
+    fsync_dir(directory)
     if keep:
-        steps = sorted(list_steps(directory))
-        for old in steps[:-keep]:
+        _prune(directory, keep)
+    return path
+
+
+def _prune(directory, keep):
+    """Retention: keep the newest ``keep`` COMPLETE checkpoints. Only
+    names fully matching ``ckpt-<step>.msgpack`` are candidates — tmp
+    files and anything else are never deleted by step order. The one
+    exception: ``.msgpack.tmp`` debris OLDER than the newest complete
+    step is a dead torn write and is swept; a newer tmp may be another
+    rank's in-flight write and is left alone."""
+    steps = list_steps(directory)
+    for old in steps[:-keep]:
+        try:
+            os.remove(_fmt(directory, old))
+        except OSError:
+            pass
+    if not steps:
+        return
+    newest = steps[-1]
+    for name in os.listdir(directory):
+        m = re.match(r"^ckpt-(\d+)\.msgpack\.tmp$", name)
+        if m and int(m.group(1)) < newest:
             try:
-                os.remove(_fmt(directory, old))
+                os.remove(os.path.join(directory, name))
             except OSError:
                 pass
-    return path
 
 
 def list_steps(directory):
@@ -135,18 +170,22 @@ def restore_or_init(directory, params, opt_state=None, axes=None):
     3. params (and opt_state) are broadcast from root so every worker
        starts identical — whether restored or freshly initialized.
 
-    Returns ``(step, params, opt_state)`` with ``step == 0`` when no
-    checkpoint existed. Designed for the eager (pre-jit) phase of a
-    training script; inside shard_map use ``hvd.broadcast_variables``
-    directly."""
+    Returns ``(step, params, opt_state, meta)`` with ``step == 0`` and
+    ``meta == {}`` when no checkpoint existed. The broadcast discipline
+    is unchanged: only the step/params/opt_state travel the collective
+    plane; ``meta`` (the small JSON dict ``save_checkpoint`` stored —
+    epoch, rng seed, notes) is restored on rank 0 and ``{}`` elsewhere.
+    Designed for the eager (pre-jit) phase of a training script; inside
+    shard_map use ``hvd.broadcast_variables`` directly."""
     import horovod_tpu as hvd
+    meta = {}
     step = resume_step(directory)
     if step > 0 and hvd.rank() == 0:
-        params, opt_state, _meta = restore_checkpoint(
+        params, opt_state, meta = restore_checkpoint(
             directory, step, params, opt_state)
     if hvd.size() > 1:
         params = hvd.broadcast_parameters(params, root_rank=0)
         if opt_state is not None:
             opt_state = hvd.broadcast_optimizer_state(opt_state,
                                                       root_rank=0)
-    return step, params, opt_state
+    return step, params, opt_state, meta

@@ -1,0 +1,64 @@
+"""Async sharded checkpointing: snapshot-offload writes, two-phase
+manifest commit, elastic N→M resharded restore.
+
+The successor to the rank-0 synchronous ``checkpoint.py`` path (which
+stays as a thin compatibility shim): once optimizer state is
+ZeRO-1-sharded (``parallel/zero.py``) and membership is elastic
+(``elastic/``), a checkpoint can no longer be "gather everything onto
+rank 0 and stall all ranks for the serialize+fsync". The design follows
+CheckFreq (FAST '21) and Gemini (SOSP '23):
+
+* **snapshot-offload** (``snapshot.py``) — the training thread pays only
+  for a fast device→host copy of params + THIS rank's ZeRO shard; the
+  serialize / CRC / write / commit runs on a background thread under a
+  bounded in-flight budget, so checkpoint cost is the copy, not the
+  write (``hvd_ckpt_blocking_seconds`` vs ``hvd_ckpt_save_seconds``).
+* **per-rank shards** (``sharded.py``) — every rank writes its own
+  ``ckpt-<step>/shard-<r>-of-<w>.msgpack`` (CRC32-protected), so write
+  bandwidth scales with the world and no shard is ever re-gathered.
+  Restore re-slices the flat ``[world, shard]`` ZeRO bucket layout
+  deterministically for ANY new world size M (the bucket partition is
+  world-independent; only the per-world padding changes).
+* **two-phase manifest commit** (``manifest.py``) — shards land + fsync
+  (phase 1), a barrier confirms every rank's shard is durable, then rank
+  0 writes ``MANIFEST.json`` + dir-fsync (phase 2). A checkpoint without
+  a manifest never existed: the loader ignores manifest-less dirs (torn
+  writes from a crash mid-save) and retention GC only counts complete
+  checkpoints.
+
+Integration: ``elastic.JaxState`` commits route through
+:class:`AsyncCheckpointer` (flushed before every re-rendezvous),
+``training.elastic_train_loop`` grows ``checkpoint_every``, telemetry
+exports ``hvd_ckpt_{save_seconds,blocking_seconds,bytes_written,
+inflight}``, and the flight recorder logs ckpt begin/commit events the
+doctor surfaces as "interrupted save" after a crash. docs/CHECKPOINT.md
+is the user-facing contract.
+"""
+
+from horovod_tpu.ckpt.manifest import (  # noqa: F401
+    MANIFEST_NAME,
+    is_complete,
+    latest_complete_step,
+    list_complete_steps,
+    read_manifest,
+    retention_gc,
+)
+from horovod_tpu.ckpt.sharded import (  # noqa: F401
+    ShardValidationError,
+    restore_sharded,
+    save_sharded,
+    shard_path,
+    step_dir,
+)
+from horovod_tpu.ckpt.snapshot import (  # noqa: F401
+    AsyncCheckpointer,
+    snapshot_tree,
+)
+
+__all__ = [
+    "AsyncCheckpointer", "snapshot_tree",
+    "save_sharded", "restore_sharded", "ShardValidationError",
+    "shard_path", "step_dir",
+    "MANIFEST_NAME", "read_manifest", "is_complete",
+    "list_complete_steps", "latest_complete_step", "retention_gc",
+]

@@ -1,0 +1,368 @@
+"""Per-rank shard files + deterministic N→M reshard-on-load.
+
+**What a shard holds.** The state tree is flattened with ``ZeroState``
+(``parallel/zero.py``) as a leaf boundary:
+
+* plain (replicated) leaves are round-robin-assigned by flat index —
+  leaf ``i`` lives in shard ``i % world`` — so write bandwidth scales
+  with the world and no byte is written twice;
+* inside each ``ZeroState``, the ``[world, shard]`` bucket-row leaves
+  are split by OWNERSHIP: rank ``r`` writes exactly row ``r`` (its own
+  optimizer shard — the bytes it already holds under ZeRO-1, never
+  re-gathered); the small replicated inner leaves (step counts etc.)
+  ride in rank 0's shard.
+
+**Why N→M reshard is deterministic.** The flat bucket partition
+(``ops/fusion.plan_buckets``) depends only on the parameter leaves and
+the fusion threshold — NOT on the world size; only the per-bucket
+padding (round up to a multiple of world) does. So the concatenation of
+the N saved rows of a bucket is ``used`` real elements plus N-padding
+zeros; restore truncates to ``used``, re-pads for M, and reshapes to
+``[M, shard_M]``. The used prefix — the actual optimizer state — is
+carried over BITWISE for any M; the manifest records the per-bucket
+used sizes so a threshold/model mismatch fails loudly instead of
+re-slicing garbage.
+
+Every shard file carries a CRC32, recorded in its ``.ok`` marker and
+aggregated into the manifest; restore verifies each shard against the
+manifest before deserializing.
+"""
+
+import logging
+import re
+import zlib
+
+import numpy as np
+
+from horovod_tpu.ckpt import manifest as manifest_lib
+
+logger = logging.getLogger("horovod_tpu")
+
+_BUCKET_KEY_RE = re.compile(r"^b(\d+)$")
+
+
+class ShardValidationError(ValueError):
+    """A shard file of an otherwise manifest-complete step is unusable:
+    it fails its manifest CRC32 (disk rot, or a manifest paired with a
+    stale phase-1 ack by the crash-adjacent re-save race). Distinct from
+    plain ``ValueError`` so ``restore_sharded`` can fall back to an
+    older complete step for per-step damage while a bucket-layout or
+    state-tree mismatch (wrong model/threshold — hits every step the
+    same) stays loud."""
+
+# re-exported layout helpers (one naming authority: manifest.py)
+step_dir = manifest_lib.step_dir
+
+
+def shard_path(root, step, rank, world):
+    import os
+    return os.path.join(manifest_lib.step_dir(root, step),
+                        manifest_lib.shard_name(rank, world))
+
+
+def _is_zero_state(x):
+    from horovod_tpu.parallel import zero as zero_lib
+    return isinstance(x, zero_lib.ZeroState)
+
+
+def _host(x):
+    import jax
+    # np.array (copy=True) rather than asarray: device_get is identity
+    # on host numpy, and even device arrays can come back as zero-copy
+    # views on the CPU backend — the copy is what actually decouples
+    # the payload from live, in-place-mutable / donated state
+    return np.array(jax.device_get(x))
+
+
+def _row(leaf, r):
+    """Row ``r`` of a ``[world, shard]`` bucket-row leaf, reading only
+    the local shard when the array is genuinely device-sharded."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is not None:
+        for s in shards:
+            idx = s.index
+            sl = idx[0] if idx else slice(None)
+            start = sl.start or 0
+            stop = sl.stop if sl.stop is not None else leaf.shape[0]
+            if start <= r < stop:
+                # np.array: the shard view can be zero-copy on the CPU
+                # backend, and the row must not alias live state
+                return np.array(np.asarray(s.data)[r - start])
+    return np.ascontiguousarray(_host(leaf)[r])
+
+
+def _bucket_index(path, leaf, sched):
+    """Bucket index when ``leaf`` is a ``[world, shard]`` bucket-row
+    living under a ``b<i>`` dict key of ``path``, else None — the
+    classification ``zero.state_specs`` shards by, sharpened with the
+    bucket-key check so a replicated leaf that happens to have a
+    world-sized dim 0 cannot be mistaken for a row. The ONE authority
+    for both save (ownership-row split) and restore (re-slice): the two
+    sides must classify identically or a leaf saved as a row would be
+    looked up as replicated."""
+    for part in reversed(path):
+        name = getattr(part, "key", None)
+        m = _BUCKET_KEY_RE.match(name) if isinstance(name, str) else None
+        if m:
+            bi = int(m.group(1))
+            shape = np.shape(leaf)
+            if (bi < len(sched.buckets) and len(shape) == 2
+                    and shape[0] == sched.world
+                    and shape[1] == sched.shard_sizes[bi]):
+                return bi
+            return None
+    return None
+
+
+def _inner_entries(zstate):
+    """``(key, bucket_index_or_None, leaf)`` per inner leaf of a
+    ZeroState: the key is the stable tree-path string."""
+    import jax
+
+    sched = zstate.plan.schedule
+    flat, _ = jax.tree_util.tree_flatten_with_path(zstate.inner)
+    return [(jax.tree_util.keystr(path), _bucket_index(path, leaf, sched),
+             leaf) for path, leaf in flat]
+
+
+def _zero_infos(leaves):
+    """Manifest-side description of every ZeroState in the outer leaf
+    list: the reshard validator (used/padded sizes are the invariants a
+    different fusion threshold or model would break)."""
+    infos = []
+    for i, leaf in enumerate(leaves):
+        if not _is_zero_state(leaf):
+            continue
+        sched = leaf.plan.schedule
+        infos.append({
+            "leaf": i,
+            "world": int(sched.world),
+            "used_sizes": [int(sum(b.sizes)) for b in sched.buckets],
+            "padded_sizes": [int(p) for p in sched.padded_sizes],
+        })
+    return infos
+
+
+def snapshot_payload(tree, rank, world):
+    """The SYNCHRONOUS half of a save: device→host copy of this rank's
+    share of ``tree``. Returns ``(payload, zero_info)`` — the payload is
+    plain nested dicts of host numpy arrays (msgpack-ready, fully
+    decoupled from the live/donated device buffers), so everything
+    after this call can run on a background thread."""
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten(tree, is_leaf=_is_zero_state)
+    repl = {}
+    zeros = {}
+    z = 0
+    for i, leaf in enumerate(leaves):
+        if _is_zero_state(leaf):
+            rows, zrepl = {}, {}
+            for key, bucket, inner_leaf in _inner_entries(leaf):
+                if bucket is not None:
+                    rows[key] = _row(inner_leaf, rank)
+                elif rank == 0:
+                    zrepl[key] = _host(inner_leaf)
+            zeros[str(z)] = {"rows": rows, "repl": zrepl}
+            z += 1
+        elif i % world == rank:
+            repl[str(i)] = _host(leaf)
+    payload = {"format": manifest_lib.FORMAT_VERSION, "rank": int(rank),
+               "world": int(world), "repl": repl, "zero": zeros}
+    return payload, _zero_infos(leaves)
+
+
+def write_shard(root, step, payload):
+    """Serialize + CRC + durably write one rank's shard, then its
+    ``.ok`` marker (the phase-1 ack). Returns ``{file, crc32, bytes}``."""
+    import os
+
+    from flax import serialization
+
+    rank, world = payload["rank"], payload["world"]
+    sdir = manifest_lib.step_dir(root, step)
+    os.makedirs(sdir, exist_ok=True)
+    data = serialization.msgpack_serialize(payload)
+    crc = zlib.crc32(data) & 0xFFFFFFFF
+    manifest_lib.atomic_write(
+        os.path.join(sdir, manifest_lib.shard_name(rank, world)), data)
+    manifest_lib.write_ok(root, step, rank, world, crc, len(data))
+    return {"file": manifest_lib.shard_name(rank, world),
+            "crc32": crc, "bytes": len(data)}
+
+
+def save_sharded(root, step, tree, rank=0, world=1, meta=None, keep=None,
+                 timeout=120.0):
+    """Synchronous single-call save: snapshot + write + commit (this
+    rank's part of the two-phase protocol). The async path
+    (``snapshot.AsyncCheckpointer``) runs the same three calls with the
+    last two on a background thread. Returns the manifest dict."""
+    manifest_lib.clear_stale_ack(root, step, rank, world)
+    payload, zero_info = snapshot_payload(tree, rank, world)
+    write_shard(root, step, payload)
+    return manifest_lib.commit(root, step, rank, world, meta=meta,
+                               zero_info=zero_info, keep=keep,
+                               timeout=timeout)
+
+
+# -- restore ----------------------------------------------------------------
+
+def _read_shard(root, step, rank, world, expect):
+    import os
+
+    from flax import serialization
+
+    path = os.path.join(manifest_lib.step_dir(root, step),
+                        manifest_lib.shard_name(rank, world))
+    with open(path, "rb") as f:
+        data = f.read()
+    crc = zlib.crc32(data) & 0xFFFFFFFF
+    if expect is not None and crc != int(expect.get("crc32", crc)):
+        raise ShardValidationError(
+            f"checkpoint shard {path} failed its CRC32 check "
+            f"(manifest {expect['crc32']:#010x}, file {crc:#010x}) — "
+            "the shard is corrupt or torn; restore a different step")
+    return serialization.msgpack_restore(data)
+
+
+def _assemble_zero(target_z, z, payloads, info):
+    """Re-slice one ZeroState's rows for the target world size."""
+    import jax
+
+    sched = target_z.plan.schedule
+    used = [int(sum(b.sizes)) for b in sched.buckets]
+    if info is None or info.get("used_sizes") != used:
+        raise ValueError(
+            "checkpoint ZeRO bucket layout does not match the restore "
+            f"target (saved used_sizes={info and info.get('used_sizes')}, "
+            f"target={used}): the bucket partition is a function of the "
+            "parameter tree and the fusion threshold — restore with the "
+            "same model and HOROVOD_FUSION_THRESHOLD it was saved under")
+    src_world = int(info["world"])
+    zkey = str(z)
+
+    def one(path, leaf):
+        key = jax.tree_util.keystr(path)
+        bucket = _bucket_index(path, leaf, sched)
+        if bucket is None:
+            try:
+                saved = payloads[0]["zero"][zkey]["repl"][key]
+            except KeyError:
+                raise ValueError(
+                    f"checkpoint is missing replicated optimizer leaf "
+                    f"{key!r} of ZeroState #{z}") from None
+            if np.shape(saved) != np.shape(leaf):
+                if np.size(saved) == np.size(leaf):
+                    saved = np.asarray(saved).reshape(np.shape(leaf))
+                else:
+                    raise ValueError(
+                        f"replicated optimizer leaf {key!r} of ZeroState "
+                        f"#{z} has shape {np.shape(saved)} in the "
+                        f"checkpoint, the restore target expects "
+                        f"{np.shape(leaf)}")
+            return saved
+        try:
+            rows = [payloads[r]["zero"][zkey]["rows"][key]
+                    for r in range(src_world)]
+        except KeyError:
+            raise ValueError(
+                f"checkpoint is missing bucket row {key!r} of "
+                f"ZeroState #{z} for some source rank") from None
+        flat = np.concatenate([np.asarray(r).reshape(-1) for r in rows])
+        n_used = used[bucket]
+        if flat.shape[0] < n_used:
+            raise ValueError(
+                f"checkpoint rows for bucket {bucket} of ZeroState #{z} "
+                f"hold {flat.shape[0]} elements < used {n_used}")
+        out = np.zeros((sched.padded_sizes[bucket],), dtype=flat.dtype)
+        out[:n_used] = flat[:n_used]
+        return out.reshape(sched.world, sched.shard_sizes[bucket])
+
+    from horovod_tpu.parallel import zero as zero_lib
+    new_inner = jax.tree_util.tree_map_with_path(one, target_z.inner)
+    return zero_lib.ZeroState(new_inner, target_z.plan)
+
+
+def restore_sharded(root, target, step=None):
+    """Load a sharded checkpoint into the structure of ``target``
+    (rank-local read — broadcast discipline is the caller's, exactly as
+    with ``checkpoint.restore_checkpoint``). ``step=None`` picks the
+    newest manifest-COMPLETE step (torn dirs are invisible) and FALLS
+    BACK to older complete steps when the newest one fails validation —
+    a shard missing or failing its manifest CRC (disk rot, or the rare
+    crash-adjacent race where a manifest paired a re-saved shard with a
+    stale phase-1 ack): torn-write philosophy, applied to reads. An
+    EXPLICIT ``step`` still fails loudly. The target may be built for a
+    different world size than the checkpoint: ZeRO bucket rows are
+    re-sliced (see module docstring) and replicated leaves are
+    reassembled from their round-robin homes. Returns
+    ``(step, tree, meta)``."""
+    if step is not None:
+        if not manifest_lib.is_complete(root, step):
+            raise FileNotFoundError(
+                f"step {step} under {root} has no "
+                f"{manifest_lib.MANIFEST_NAME} (incomplete/torn "
+                "checkpoint)")
+        return _restore_step(root, target, step)
+    steps = manifest_lib.list_complete_steps(root)
+    if not steps:
+        raise FileNotFoundError(
+            f"no manifest-complete checkpoint under {root}")
+    last_err = None
+    for s in reversed(steps):
+        try:
+            return _restore_step(root, target, s)
+        except (OSError, ShardValidationError) as e:
+            # ONLY shard-validation failures fall back; a bucket-layout
+            # or state-tree mismatch (wrong model/threshold) hits every
+            # step the same and must stay loud
+            logger.warning(
+                "ckpt: step %d under %s is unrestorable (%s) — falling "
+                "back to the previous complete step", s, root, e)
+            last_err = e
+    raise ValueError(
+        f"no restorable checkpoint under {root}: all {len(steps)} "
+        f"manifest-complete step(s) failed validation") from last_err
+
+
+def _restore_step(root, target, step):
+    import jax
+
+    man = manifest_lib.read_manifest(root, step)
+    src_world = int(man["world"])
+    shards = man.get("shards") or {}
+    payloads = [_read_shard(root, step, r, src_world, shards.get(str(r)))
+                for r in range(src_world)]
+
+    zero_by_index = {int(i["leaf"]): i for i in (man.get("zero") or [])}
+    leaves, treedef = jax.tree_util.tree_flatten(
+        target, is_leaf=_is_zero_state)
+    # the z-th ZeroState in leaf order pairs with payload key str(z);
+    # manifest zero infos are keyed by SAVED outer-leaf index, which must
+    # line up with the target's (same state tree shape)
+    out, z = [], 0
+    for i, leaf in enumerate(leaves):
+        if _is_zero_state(leaf):
+            out.append(_assemble_zero(leaf, z, payloads,
+                                      zero_by_index.get(i)))
+            z += 1
+            continue
+        try:
+            saved = payloads[i % src_world]["repl"][str(i)]
+        except KeyError:
+            raise ValueError(
+                f"checkpoint step {step} has no leaf {i} — it was saved "
+                f"from a different state tree ({len(leaves)} target "
+                "leaves)") from None
+        if np.shape(saved) != np.shape(leaf):
+            # msgpack round-trips 0-d arrays as shape (1,); any
+            # same-size difference is a benign layout artifact
+            if np.size(saved) == np.size(leaf):
+                saved = np.asarray(saved).reshape(np.shape(leaf))
+            else:
+                raise ValueError(
+                    f"checkpoint leaf {i} has shape {np.shape(saved)}, "
+                    f"the restore target expects {np.shape(leaf)}")
+        out.append(saved)
+    return step, jax.tree_util.tree_unflatten(treedef, out), \
+        man.get("meta") or {}

@@ -1,0 +1,238 @@
+"""Snapshot-offload: the training thread pays for the copy, not the write.
+
+:class:`AsyncCheckpointer` is the CheckFreq/Gemini-style split of a save
+into a fast SYNCHRONOUS device→host snapshot (``sharded.
+snapshot_payload`` — decoupled from the live, possibly donated, device
+buffers) and a background serialize → CRC → fsync → two-phase commit
+(``sharded.write_shard`` + ``manifest.commit``). Training stalls for
+``hvd_ckpt_blocking_seconds`` (the snapshot, plus any wait for the
+bounded in-flight budget); the full ``hvd_ckpt_save_seconds`` overlaps
+the next training steps.
+
+The background thread NEVER touches the collective plane — the commit
+barrier is the shared-filesystem ``.ok`` protocol (manifest.py) — so an
+in-flight save can overlap training collectives without desync risk.
+
+``flush()`` forces every queued save to durability and re-raises the
+first background failure; the elastic plane calls it before every
+re-rendezvous (``elastic/state.py``) so a membership change can never
+orphan a half-written step, and ``close()`` is registered via
+``atexit`` as a last resort for clean interpreter exits.
+"""
+
+import atexit
+import logging
+import os
+import queue
+import threading
+import time
+
+from horovod_tpu.ckpt import manifest as manifest_lib
+from horovod_tpu.ckpt import sharded
+
+logger = logging.getLogger("horovod_tpu")
+
+snapshot_tree = sharded.snapshot_payload  # the synchronous half, exported
+
+DEFAULT_KEEP = 5
+
+
+def _env_rank_world():
+    import horovod_tpu as hvd
+    if hvd.is_initialized():
+        return hvd.rank(), hvd.size()
+    return (int(os.environ.get("HOROVOD_RANK", "0")),
+            int(os.environ.get("HOROVOD_SIZE", "1")))
+
+
+class AsyncCheckpointer:
+    """Bounded-budget async sharded checkpoint writer for one rank.
+
+    ``max_inflight`` caps queued-but-uncommitted saves: when the budget
+    is exhausted, ``save()`` blocks until the oldest save commits (the
+    wait is part of the blocking metric — a budget stall is a real
+    training stall and must be visible, not hidden). ``keep`` is the
+    retention GC depth (complete checkpoints, enforced by rank 0 at
+    each commit)."""
+
+    def __init__(self, directory, keep=DEFAULT_KEEP, max_inflight=1,
+                 rank=None, world=None, barrier_timeout=None,
+                 registry=None):
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got "
+                             f"{max_inflight}")
+        if barrier_timeout is None:
+            barrier_timeout = float(
+                os.environ.get("HOROVOD_CKPT_TIMEOUT", "120"))
+        env_rank, env_world = _env_rank_world()
+        self.directory = directory
+        self.keep = keep
+        self.rank = env_rank if rank is None else int(rank)
+        self.world = env_world if world is None else int(world)
+        self.barrier_timeout = barrier_timeout
+        self.max_inflight = max_inflight
+        from horovod_tpu.telemetry import instruments as _tele
+        self._metrics = _tele.ckpt_instruments(registry)
+        self._queue = queue.Queue()
+        self._inflight = 0
+        self._lock = threading.Condition()
+        self._error = None
+        self._thread = None
+        self._closed = False
+        self._abandoned = False
+        self.last_manifest = None
+        atexit.register(self.close)
+
+    # -- the training-thread half ------------------------------------------
+    def save(self, step, tree, meta=None, block=False):
+        """Snapshot ``tree`` now; persist + commit in the background.
+        Returns the seconds training was blocked. ``block=True`` turns
+        this save synchronous (snapshot + wait for its commit)."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._reraise()
+        t0 = time.perf_counter()
+        with self._lock:
+            while self._inflight >= self.max_inflight and not self._error:
+                self._lock.wait(0.005)
+            self._reraise()
+            self._inflight += 1
+            self._metrics.inflight.set(self._inflight)
+        from horovod_tpu.diag import recorder as _flightrec
+        _flightrec.record_event("ckpt", ph="B", step=int(step),
+                                rank=self.rank, world=self.world)
+        try:
+            # re-saving a step whose previous attempt was torn — or
+            # whose damaged manifest a fallback restore skipped: clear
+            # the old manifest and our stale phase-1 ack on the
+            # TRAINING thread (the commit-cadence sync point), so no
+            # peer barrier can pair a manifest with stale shards
+            manifest_lib.clear_stale_ack(self.directory, step, self.rank,
+                                         self.world)
+            payload, zero_info = sharded.snapshot_payload(tree, self.rank,
+                                                          self.world)
+        except BaseException:
+            # no job was queued: give the budget slot back, or every
+            # later save()/flush() parks on it forever
+            with self._lock:
+                self._inflight -= 1
+                self._metrics.inflight.set(self._inflight)
+                self._lock.notify_all()
+            _flightrec.record_event("ckpt", ph="E", step=int(step),
+                                    rank=self.rank, ok=False,
+                                    error="snapshot failed")
+            raise
+        blocking = time.perf_counter() - t0
+        self._metrics.blocking_seconds.observe(blocking)
+        self._ensure_thread()
+        self._queue.put((int(step), payload, zero_info, meta, t0))
+        if block:
+            self.flush()
+        return blocking
+
+    def flush(self, timeout=None):
+        """Block until every queued save has committed; re-raise the
+        first background failure. Call before a rendezvous, a restore,
+        or process exit."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._lock:
+            while self._inflight > 0 and self._error is None:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"ckpt flush: {self._inflight} save(s) still in "
+                        f"flight after {timeout:.0f}s")
+                self._lock.wait(0.01)
+        self._reraise()
+        return self.last_manifest
+
+    def close(self, timeout=None):
+        """Flush (best effort) and stop the background thread."""
+        if self._closed:
+            return
+        try:
+            self.flush(timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — exit path must not throw
+            logger.warning("ckpt: close() dropping failed save: %s", e)
+        self._closed = True
+        atexit.unregister(self.close)  # elastic churn replaces writers;
+        if self._thread is not None:   # don't pin dead ones for life
+            self._queue.put(None)
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def abandon(self):
+        """Stop WITHOUT waiting for in-flight saves: once membership
+        broke, the commit barrier may never complete, and the elastic
+        recovery path must not park on it. Queued-but-unwritten saves
+        are DROPPED (a shard this writer lands minutes from now could
+        pair with a manifest the post-reset world commits for the same
+        step); only a save already mid-write drains, bounded by its own
+        barrier timeout. The torn step dir stays invisible to restore
+        (no manifest) and is GC'd later."""
+        self._abandoned = True
+        self._closed = True
+        atexit.unregister(self.close)
+        self._queue.put(None)
+
+    # -- the background half -----------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name="hvd-ckpt-writer", daemon=True)
+            self._thread.start()
+
+    def _worker(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            step, payload, zero_info, meta, t0 = job
+            if self._abandoned:
+                from horovod_tpu.diag import recorder as _flightrec
+                _flightrec.record_event("ckpt", ph="E", step=int(step),
+                                        rank=self.rank, ok=False,
+                                        error="abandoned before write")
+                with self._lock:
+                    self._inflight -= 1
+                    self._metrics.inflight.set(self._inflight)
+                    self._lock.notify_all()
+                continue
+            try:
+                info = sharded.write_shard(self.directory, step, payload)
+                man = manifest_lib.commit(
+                    self.directory, step, self.rank, self.world, meta=meta,
+                    zero_info=zero_info, keep=self.keep,
+                    timeout=self.barrier_timeout)
+                self.last_manifest = man
+                dt = time.perf_counter() - t0
+                self._metrics.bytes_written.inc(info["bytes"])
+                self._metrics.save_seconds.observe(dt)
+                from horovod_tpu.diag import recorder as _flightrec
+                _flightrec.record_event("ckpt", ph="E", step=int(step),
+                                        rank=self.rank, ok=True,
+                                        bytes=info["bytes"],
+                                        save_s=round(dt, 4))
+                logger.debug("ckpt: committed step %d (%d bytes, %.1f ms "
+                             "end-to-end)", step, info["bytes"], dt * 1e3)
+            except Exception as e:  # noqa: BLE001 — surfaced via flush()
+                logger.error("ckpt: background save of step %s failed: %s",
+                             step, e)
+                from horovod_tpu.diag import recorder as _flightrec
+                _flightrec.record_event("ckpt", ph="E", step=int(step),
+                                        rank=self.rank, ok=False,
+                                        error=str(e)[:160])
+                with self._lock:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self._metrics.inflight.set(self._inflight)
+                    self._lock.notify_all()
+
+    def _reraise(self):
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise RuntimeError(
+                f"ckpt: a background checkpoint save failed: {e}") from e

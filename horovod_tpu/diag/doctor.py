@@ -91,6 +91,25 @@ def _last_event(dump, kinds=None):
     return None
 
 
+def _open_ckpt_saves(dump):
+    """Checkpoint steps this rank BEGAN saving (``ckpt`` ph=B) with no
+    matching commit/failure (ph=E) in the ring: saves the crash
+    interrupted. Their manifest was never written, so restore falls back
+    to the previous complete step — worth saying out loud. Paired in
+    event order, not by set membership: a step saved twice (failed or
+    torn once, re-saved after restore) is open again after its later
+    B, no matter how its first attempt ended."""
+    open_ = {}
+    for ev in dump.get("events") or []:
+        if ev.get("k") != "ckpt":
+            continue
+        if ev.get("ph") == "B" and ev.get("step") is not None:
+            open_[ev["step"]] = ev
+        elif ev.get("ph") == "E":
+            open_.pop(ev.get("step"), None)
+    return sorted(open_)
+
+
 def diagnose(dumps, expected_size=None):
     """Build the report dict from ``{rank: dump}`` (see
     :func:`load_dumps`). Pure function of the dumps — unit-testable with
@@ -140,6 +159,12 @@ def diagnose(dumps, expected_size=None):
     cause, why = _classify(expected, dead, digest_view, per_rank, parked,
                            clean)
 
+    interrupted_saves = {}
+    for r in ranks:
+        pend = _open_ckpt_saves(dumps[r])
+        if pend:
+            interrupted_saves[r] = pend
+
     timeline = []
     for r in ranks:
         for ev in (dumps[r].get("events") or [])[-TIMELINE_EVENTS_PER_RANK:]:
@@ -156,6 +181,7 @@ def diagnose(dumps, expected_size=None):
         "config_mismatch": config_mismatch,
         "classification": cause,
         "explanation": why,
+        "interrupted_saves": interrupted_saves,
         "timeline": timeline,
     }
 
@@ -255,6 +281,11 @@ def format_report(report):
         add("CONFIG MISMATCH: ranks ran with differing config "
             f"fingerprints {report['config_mismatch']} — check HOROVOD_* "
             "env parity")
+    for r, steps in sorted((report.get("interrupted_saves") or {}).items()):
+        add(f"INTERRUPTED CHECKPOINT SAVE: rank {r} was mid-save of "
+            f"step(s) {steps} when the job died — no manifest was "
+            "committed, so restore falls back to the last complete "
+            "checkpoint (the torn dir is ignored and later GC'd)")
     add(f"probable cause: {report['classification']} — "
         f"{report['explanation']}")
     add("timeline (clock-aligned, last events per rank):")

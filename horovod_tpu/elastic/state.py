@@ -28,10 +28,15 @@ _UNCOMMITTED = 1 << 30
 
 
 def _env_rank():
-    import horovod_tpu as hvd
-    if hvd.is_initialized():
-        return hvd.rank()
-    return int(os.environ.get("HOROVOD_RANK", "0"))
+    # one authority for the initialized-hvd-or-env resolution, shared
+    # with the checkpointer it keys (ckpt.snapshot)
+    from horovod_tpu.ckpt.snapshot import _env_rank_world
+    return _env_rank_world()[0]
+
+
+def _env_world():
+    from horovod_tpu.ckpt.snapshot import _env_rank_world
+    return _env_rank_world()[1]
 
 
 class State:
@@ -57,6 +62,24 @@ class State:
         self._reset_callbacks.extend(callbacks)
 
     def on_reset(self):
+        # a reset means the world is about to change shape: any async
+        # checkpoint still in flight must reach durability first, or the
+        # new world could restore a step the old world never committed.
+        # The wait is BOUNDED: when the reset is happening because a
+        # peer died, that peer's shard never lands and the two-phase
+        # commit barrier can never complete — an unbounded flush would
+        # park the whole recovery path on a dead rank. On timeout the
+        # in-flight save is abandoned (its manifest-less dir is
+        # invisible to restore and GC'd later).
+        try:
+            self.flush(timeout=float(
+                os.environ.get("HOROVOD_CKPT_RESET_TIMEOUT", "10")))
+        except Exception as e:  # noqa: BLE001 — a failed flush must not
+            logger.warning("elastic: checkpoint flush before reset "
+                           "failed: %s — abandoning the in-flight save "
+                           "(restore falls back to the last committed "
+                           "manifest)", e)  # block the recovery path
+            self._abandon_pending_saves()
         # the re-rendezvous that triggered this reset may have changed
         # the device set; a stale cached proc mesh (built from the old
         # jax.devices()) would corrupt the next eager collective
@@ -114,6 +137,15 @@ class State:
         membership change since the last check."""
         self._notification_manager.check()
 
+    def flush(self, timeout=None):
+        """Force any asynchronous persistence to durability. Called by
+        the elastic loop before every re-rendezvous (and by subclasses
+        with disk-backed commits); base states have nothing pending."""
+
+    def _abandon_pending_saves(self):
+        """Drop asynchronous persistence that cannot complete (e.g. a
+        commit barrier broken by a dead peer); base states have none."""
+
     # -- subclass payload hooks ---------------------------------------------
     def save(self):
         raise NotImplementedError
@@ -162,7 +194,8 @@ class ObjectState(State):
     def sync(self, root_rank=None):
         """Adopt the committed state of ``root_rank`` (default: the
         lowest rank that has committed; the election and the broadcast
-        both ride the collective plane, so this is a collective call)."""
+        both ride the collective plane, so this is a collective call).
+        Returns the rank the state was adopted from."""
         root = _elect_root(root_rank, self.has_commit())
         if root is None:
             # nobody has progress: baseline is the fresh init — but the
@@ -182,12 +215,13 @@ class ObjectState(State):
                     "cross-relaunch continuity.", epoch)
             self._adopt(_broadcast_tree(self._capture(), 0))
             self.save()
-            return
+            return 0
         payload = (self._saved_state if self.has_commit()
                    else self._capture())
         synced = _broadcast_tree(payload, root)
         self._adopt(synced)
         self._saved_state = self._capture()
+        return root
 
 
 class JaxState(ObjectState):
@@ -195,27 +229,48 @@ class JaxState(ObjectState):
     ``opt_state``, a whole ``TrainState``, scalars...) with
 
     * **commit** — pulls every leaf to host memory (``device_get``) and,
-      when ``directory`` is given, writes a ``checkpoint.py`` msgpack
-      from rank 0 (atomic; survives full process loss),
+      when ``directory`` is given, persists through the async sharded
+      checkpoint subsystem (``horovod_tpu/ckpt``): EVERY rank writes its
+      own shard (this rank's ZeRO rows included, never re-gathered), the
+      serialize/fsync overlaps training on a background thread, and rank
+      0 commits the two-phase manifest. ``checkpoint_every=K`` thins the
+      disk cadence to every K-th commit; ``async_save=False`` restores
+      the old stall-until-durable behavior.
     * **restore** — re-adopts the last in-memory commit, falling back to
-      the newest on-disk checkpoint for freshly (re)spawned workers,
+      the newest MANIFEST-complete on-disk checkpoint for freshly
+      (re)spawned workers (resharding ZeRO state when the world size
+      changed N→M); legacy rank-0 ``ckpt-<n>.msgpack`` files from the
+      pre-subsystem format still restore.
     * **sync** — broadcasts the trees from the lowest committed rank via
       ``ops.collective`` so surviving workers hand their progress to new
       ones without touching disk.
+    * **flush** — forces in-flight async saves to durability; the
+      elastic loop calls it before every re-rendezvous.
     """
 
     def __init__(self, directory=None, keep=3, notification_manager=None,
-                 **kwargs):
+                 async_save=True, checkpoint_every=1, **kwargs):
         super().__init__(notification_manager=notification_manager,
                          **kwargs)
         self._directory = directory
         self._keep = keep
+        self._async_save = async_save
+        self.checkpoint_every = max(1, int(checkpoint_every))
         self._commit_count = 0
+        self._ckpt = None
 
     def _capture(self):
+        # a REAL host copy, ZeroState included (it is a registered
+        # pytree, so tree_map reaches its inner arrays): the training
+        # step donates its input buffers (make_train_step donate=True),
+        # so holding device references here would hand restore()/sync()
+        # deleted arrays after the very next step. np.array, not
+        # asarray — device_get is identity on numpy-backed state (and
+        # can be zero-copy on the CPU backend), and the commit must not
+        # alias arrays the loop mutates in place
         import jax
         return {k: jax.tree_util.tree_map(
-                    lambda x: np.asarray(jax.device_get(x)),
+                    lambda x: np.array(jax.device_get(x)),
                     getattr(self, k))
                 for k in self._state_keys}
 
@@ -223,19 +278,44 @@ class JaxState(ObjectState):
         for k in self._state_keys:
             setattr(self, k, values[k])
 
+    def _checkpointer(self):
+        from horovod_tpu import ckpt as ckpt_lib
+        rank, world = _env_rank(), _env_world()
+        if self._ckpt is not None and (self._ckpt.rank != rank
+                                       or self._ckpt.world != world):
+            # the world changed shape under us (elastic re-rendezvous):
+            # drain the old writer (bounded — its commit barrier may be
+            # waiting on ranks that no longer exist), shard for the new
+            # membership
+            self._ckpt.close(timeout=5.0)
+            self._ckpt = None
+        if self._ckpt is None:
+            self._ckpt = ckpt_lib.AsyncCheckpointer(
+                self._directory, keep=self._keep, rank=rank, world=world)
+        return self._ckpt
+
     def save(self):
         self._saved_state = self._capture()
         self._commit_count += 1
-        if self._directory and _env_rank() == 0:
-            from horovod_tpu import checkpoint
-            # flax msgpack only knows plain containers, but state may
-            # hold custom pytree nodes (e.g. a whole TrainState): ship
-            # flattened leaves and rebuild against the live structure
-            payload = {k: _leaf_dict(v)
-                       for k, v in self._saved_state.items()}
-            checkpoint.write_checkpoint(
-                self._directory, self._commit_count, payload,
-                meta={"commit": self._commit_count}, keep=self._keep)
+        if self._directory and \
+                self._commit_count % self.checkpoint_every == 0:
+            # hand the writer the capture itself: it is already host
+            # numpy (ZeroState structure preserved by tree_map), so the
+            # snapshot half's device_get degrades to a no-op instead of
+            # pulling the live device tree a second time per commit
+            self._checkpointer().save(
+                self._commit_count, self._saved_state,
+                meta={"commit": self._commit_count},
+                block=not self._async_save)
+
+    def flush(self, timeout=None):
+        if self._ckpt is not None:
+            self._ckpt.flush(timeout=timeout)
+
+    def _abandon_pending_saves(self):
+        if self._ckpt is not None:
+            self._ckpt.abandon()
+            self._ckpt = None
 
     def restore(self):
         if self._saved_state is None:
@@ -246,6 +326,20 @@ class JaxState(ObjectState):
         if not self._directory:
             return False
         from horovod_tpu import checkpoint
+        from horovod_tpu import ckpt as ckpt_lib
+        if self._ckpt is not None:
+            self._ckpt.flush()  # never restore past an in-flight save
+        if ckpt_lib.latest_complete_step(self._directory) is not None:
+            target = {k: getattr(self, k) for k in self._state_keys}
+            step, restored, meta = ckpt_lib.restore_sharded(
+                self._directory, target)
+            self._saved_state = restored
+            self._commit_count = int(meta.get("commit", step))
+            logger.info("elastic: restored commit %d from sharded "
+                        "checkpoint %s", self._commit_count,
+                        self._directory)
+            return True
+        # legacy single-file format (pre-ckpt-subsystem checkpoints)
         steps = checkpoint.list_steps(self._directory)
         if not steps:
             return False
@@ -267,7 +361,15 @@ class JaxState(ObjectState):
         if self._saved_state is None:
             self._restore_from_disk()
             super().restore()
-        super().sync(root_rank=root_rank)
+        root = super().sync(root_rank=root_rank)
+        # the trees just adopted are ``root``'s commit — adopt its commit
+        # COUNTER too: a disk-restored newcomer sits at the on-disk count
+        # while survivors are in-memory ahead, and ranks that disagree on
+        # the count would write their next shards under DIFFERENT step
+        # numbers, a two-phase commit barrier that can never complete
+        self._commit_count = int(np.asarray(_broadcast_tree(
+            np.asarray(self._commit_count, dtype=np.int64), root)))
+        return root
 
 
 def _leaf_dict(tree):

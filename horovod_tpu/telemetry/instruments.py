@@ -44,6 +44,11 @@ RECOVERY_SECONDS = "horovod_recovery_seconds"
 STRAGGLER_RATIO = "horovod_straggler_step_time_ratio"
 # -- stall inspector --------------------------------------------------------
 STALLED_RANKS = "horovod_stalled_ranks"
+# -- async sharded checkpointing (horovod_tpu/ckpt) -------------------------
+CKPT_SAVE_SECONDS = "hvd_ckpt_save_seconds"
+CKPT_BLOCKING_SECONDS = "hvd_ckpt_blocking_seconds"
+CKPT_BYTES_WRITTEN = "hvd_ckpt_bytes_written"
+CKPT_INFLIGHT = "hvd_ckpt_inflight"
 
 
 def enabled(env=None):
@@ -201,6 +206,35 @@ def record_bucket(kind, fill_ratio, nbytes, dispatch_s=None):
     _bytes_child(f"bucket_{kind}").inc(max(0, int(nbytes)))
     if dispatch_s is not None:
         dispatch.observe(dispatch_s)
+
+
+class CkptInstruments:
+    """The checkpoint subsystem's four instruments, resolved once per
+    ``AsyncCheckpointer``: end-to-end save latency (snapshot through
+    manifest commit), the training-thread stall alone (snapshot + any
+    in-flight-budget wait — the number the async design minimizes),
+    cumulative shard bytes, and the current in-flight save count."""
+
+    def __init__(self, registry=None):
+        r = registry if registry is not None else get_registry()
+        self.save_seconds = r.histogram(
+            CKPT_SAVE_SECONDS,
+            "End-to-end checkpoint save seconds (snapshot -> shard write "
+            "-> manifest commit), overlapped with training")
+        self.blocking_seconds = r.histogram(
+            CKPT_BLOCKING_SECONDS,
+            "Seconds the TRAINING thread was blocked per save (device->"
+            "host snapshot + in-flight-budget wait)")
+        self.bytes_written = r.counter(
+            CKPT_BYTES_WRITTEN, "Checkpoint shard bytes written by this "
+            "rank (serialized msgpack, pre-filesystem)")
+        self.inflight = r.gauge(
+            CKPT_INFLIGHT, "Checkpoint saves snapshotted but not yet "
+            "manifest-committed")
+
+
+def ckpt_instruments(registry=None):
+    return CkptInstruments(registry)
 
 
 def stalled_ranks_gauge(registry=None):

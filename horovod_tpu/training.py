@@ -405,7 +405,8 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
 
 
 def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
-                       commit_every=1, on_step=None):
+                       commit_every=1, checkpoint_every=None,
+                       on_step=None):
     """Drive ``train_step`` under the elastic retry loop
     (``hvd.elastic.run``): commit/restore/sync semantics come from
     ``elastic_state`` (a ``hvd.elastic.JaxState`` whose ``train_state``
@@ -417,6 +418,15 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
     a restored worker re-reads the right batch); ``on_step(step, loss)``
     is an optional observer. Returns the final ``TrainState``.
 
+    ``checkpoint_every=K`` sets the DISK cadence independently of the
+    in-memory ``commit_every``: every K-th commit is persisted through
+    the async sharded checkpoint subsystem (``horovod_tpu/ckpt``,
+    docs/CHECKPOINT.md), where the training stall is only the
+    device→host snapshot — the serialize/fsync/manifest commit overlaps
+    the following steps (``hvd_ckpt_blocking_seconds`` vs
+    ``hvd_ckpt_save_seconds``). Requires a ``JaxState`` built with a
+    ``directory``; the final commit always flushes to disk.
+
     When telemetry is enabled and ``train_step`` is not already an
     instrumented ``make_train_step`` build, the loop records step
     latency / examples-per-sec itself, so a hand-written step function
@@ -426,6 +436,13 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
 
     from horovod_tpu import elastic as _elastic
     from horovod_tpu import telemetry as telemetry_lib
+
+    if checkpoint_every is not None:
+        if not getattr(elastic_state, "_directory", None):
+            raise ValueError(
+                "checkpoint_every needs an elastic state with a "
+                "checkpoint directory (JaxState(directory=...))")
+        elastic_state.checkpoint_every = max(1, int(checkpoint_every))
 
     own_instruments = None
     if telemetry_lib.enabled() and not hasattr(train_step, "instruments"):
@@ -460,7 +477,20 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
             if on_step is not None:
                 on_step(done, float(jax.device_get(loss)))
             if done % commit_every == 0 or done >= num_steps:
-                state.commit()
+                if done >= num_steps and hasattr(state, "checkpoint_every"):
+                    # the final commit must reach disk regardless of the
+                    # thinned cadence — but the cadence itself must
+                    # survive (an elastic retry re-enters this loop with
+                    # the same state object)
+                    cadence = state.checkpoint_every
+                    state.checkpoint_every = 1
+                    try:
+                        state.commit()
+                    finally:
+                        state.checkpoint_every = cadence
+                else:
+                    state.commit()
+        state.flush()  # drain any async save before leaving the loop
         return state.train_state
 
     return _loop(elastic_state)

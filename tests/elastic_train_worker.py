@@ -70,6 +70,19 @@ def main():
                                         "step": int(state.step),
                                         "loss": loss}) + "\n")
             if (die_host and host == die_host and epoch < die_until_epoch):
+                # commits are ASYNC now (horovod_tpu/ckpt): the scenario
+                # is "crash strikes after the checkpoint reached
+                # durability", so force the in-flight save to its
+                # manifest before dying (a crash racing the write is
+                # test_launcher's SIGKILL-mid-save e2e instead). BOUNDED:
+                # ranks run this loop at independent speeds, so the
+                # commit barrier may be waiting on a lagging peer's
+                # shard — on a loaded box an unbounded flush would delay
+                # the death past the test's stall windows
+                try:
+                    state.flush(timeout=15.0)
+                except Exception:
+                    pass  # die anyway; restore falls back a step
                 os.kill(os.getpid(), signal.SIGKILL)
         return int(state.step)
 

@@ -33,8 +33,10 @@ def _make_train(ckpt_dir, crash_at):
         opt = hvd.DistributedOptimizer(optax.adam(0.1))
         state = opt.init(params)
 
-        step, params, state = checkpoint.restore_or_init(
+        step, params, state, meta = checkpoint.restore_or_init(
             ckpt_dir, params, state)
+        if step > 0 and hvd.rank() == 0:
+            assert meta == {"note": "test"}  # saved meta comes back
 
         @jax.jit
         def loss_and_grad(p):
@@ -96,7 +98,8 @@ def test_rank0_only_writes(tmp_path):
         params = {"w": np.full((3,), float(hvd.rank() + 1),
                                dtype=np.float32)}
         path = checkpoint.save_checkpoint(ckpt_dir, 5, params)
-        step, params, _ = checkpoint.restore_or_init(ckpt_dir, params)
+        step, params, _, _meta = checkpoint.restore_or_init(ckpt_dir,
+                                                            params)
         return (path is not None, step, float(params["w"][0]))
 
     results = api.run(probe, np=2, extra_env={"JAX_PLATFORMS": "cpu"})

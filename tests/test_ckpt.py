@@ -1,0 +1,719 @@
+"""Async sharded checkpointing (ISSUE 5): N→M reshard-on-load parity
+against an unsharded oracle, two-phase manifest torn-write recovery,
+and the headline claim — the training-thread stall of an async save is
+a small fraction of the synchronous write (asserted through the
+``hvd_ckpt_blocking_seconds`` metric, incl. a real 2-rank run with
+``checkpoint_every``). See docs/CHECKPOINT.md for the protocol."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu import ckpt as ckpt_lib
+from horovod_tpu.ckpt import manifest as manifest_lib
+from horovod_tpu.ckpt import sharded as sharded_lib
+from horovod_tpu.ops import fusion
+from horovod_tpu.parallel import zero
+from horovod_tpu.run import api
+
+THRESHOLD = 64  # bytes — small, so the tiny test params span 3 buckets
+
+
+def _params():
+    rng = np.random.default_rng(7)
+    return {"w1": jnp.asarray(rng.standard_normal(7), jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((3, 5)), jnp.float32),
+            "w3": jnp.asarray(rng.standard_normal(9), jnp.float32)}
+
+
+def _rows_state(tx, params, grads, world, steps=3, threshold=THRESHOLD):
+    """A ZeroState for ``world`` after ``steps`` elementwise updates on
+    the bucket-row view — built WITHOUT a mesh (the schedule is a pure
+    function of leaves/threshold/world), so one process can play any
+    rank of any world size."""
+    leaves = jax.tree_util.tree_leaves(params)
+    sched = fusion.bucket_schedule(leaves, world, threshold_bytes=threshold,
+                                   axes=("data",))
+    plan = zero.ZeroPlan(schedule=sched)
+    zstate = zero.init(tx, params, plan)
+    gl = jax.tree_util.tree_leaves(grads)
+    grad_rows = {f"b{i}": zero._bucket_rows(sched, i, gl)
+                 for i in range(len(sched.buckets))}
+    param_rows = {f"b{i}": zero._bucket_rows(sched, i, leaves)
+                  for i in range(len(sched.buckets))}
+    inner = zstate.inner
+    for _ in range(steps):
+        _, inner = tx.update(grad_rows, inner, param_rows)
+    return zero.ZeroState(inner, plan), sched
+
+
+def _save_world(root, step, tree, world, meta=None):
+    """Play all ``world`` ranks of one save in-process: every rank's
+    shard + phase-1 ack, then the two-phase commit."""
+    zi = None
+    for r in range(world):
+        payload, zi = ckpt_lib.snapshot_tree(tree, r, world)
+        sharded_lib.write_shard(root, step, payload)
+    return manifest_lib.commit(root, step, 0, world, meta=meta,
+                               zero_info=zi, keep=None)
+
+
+# ---- N→M resharded restore --------------------------------------------
+
+
+@pytest.mark.parametrize("m", [2, 3, 1])
+def test_reshard_restore_bitwise_parity(tmp_path, m):
+    """Save at world=4, restore at world=m: every bucket's USED prefix
+    of the optimizer state (adam mu/nu) must be BITWISE equal to the
+    packed unsharded oracle — the same optax state computed with no
+    sharding at all — and replicated leaves must round-trip exactly."""
+    params = _params()
+    grads = jax.tree_util.tree_map(lambda x: jnp.ones_like(x) * 0.37,
+                                   params)
+    tx = optax.adam(1e-2)
+
+    z4, _ = _rows_state(tx, params, grads, world=4)
+    _save_world(str(tmp_path), 10, {"params": params, "opt": z4}, 4,
+                meta={"commit": 10})
+
+    # unsharded oracle: plain adam over the full tree, same 3 updates
+    full = tx.init(params)
+    for _ in range(3):
+        _, full = tx.update(grads, full, params)
+    mu_leaves = jax.tree_util.tree_leaves(full[0].mu)
+    nu_leaves = jax.tree_util.tree_leaves(full[0].nu)
+
+    zm, sched_m = _rows_state(tx, params, grads, world=m, steps=0)
+    target = {"params": jax.tree_util.tree_map(jnp.zeros_like, params),
+              "opt": zm}
+    step, restored, meta = ckpt_lib.restore_sharded(str(tmp_path), target)
+    assert step == 10 and meta == {"commit": 10}
+
+    inner = restored["opt"].inner
+    assert int(np.asarray(inner[0].count)) == 3
+    for i, bucket in enumerate(sched_m.buckets):
+        used = int(sum(bucket.sizes))
+        for got_rows, oracle in ((inner[0].mu, mu_leaves),
+                                 (inner[0].nu, nu_leaves)):
+            got = np.asarray(got_rows[f"b{i}"])
+            assert got.shape == (m, sched_m.shard_sizes[i])
+            np.testing.assert_array_equal(
+                got.reshape(-1)[:used],
+                np.asarray(fusion._pack(bucket, oracle))[:used])
+            # padding beyond the used prefix is zeros, never garbage
+            np.testing.assert_array_equal(got.reshape(-1)[used:], 0.0)
+    for k, v in params.items():
+        np.testing.assert_array_equal(np.asarray(restored["params"][k]),
+                                      np.asarray(v))
+
+
+def test_reshard_rejects_mismatched_bucket_layout(tmp_path):
+    """A different fusion threshold partitions different buckets; the
+    manifest's used_sizes must make that restore fail loudly instead of
+    re-slicing garbage."""
+    params = _params()
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    tx = optax.adam(1e-2)
+    z4, _ = _rows_state(tx, params, grads, world=4)
+    _save_world(str(tmp_path), 1, {"opt": z4}, 4)
+    z2, _ = _rows_state(tx, params, grads, world=2, steps=0,
+                        threshold=1 << 20)  # one big bucket
+    with pytest.raises(ValueError, match="bucket layout"):
+        ckpt_lib.restore_sharded(str(tmp_path), {"opt": z2})
+
+
+def test_reshard_rejects_mismatched_replicated_leaf(tmp_path):
+    """A replicated inner ZeroState leaf whose saved size differs from
+    the restore target must fail loudly like every other mismatch, not
+    silently install the wrong array."""
+    params = _params()
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    tx = optax.adam(1e-2)
+    z1, _ = _rows_state(tx, params, grads, world=1)
+    _save_world(str(tmp_path), 1, {"opt": z1}, 1)
+    ztarget, _ = _rows_state(tx, params, grads, world=1, steps=0)
+    man = manifest_lib.read_manifest(str(tmp_path), 1)
+    payload = sharded_lib._read_shard(str(tmp_path), 1, 0, 1, None)
+    key = next(iter(payload["zero"]["0"]["repl"]))
+    payload["zero"]["0"]["repl"][key] = np.zeros(17, np.float32)
+    with pytest.raises(ValueError, match="restore target expects"):
+        sharded_lib._assemble_zero(ztarget, 0, [payload], man["zero"][0])
+
+
+# ---- two-phase manifest: torn writes, CRC, retention ------------------
+
+
+def test_torn_write_recovery(tmp_path):
+    """A checkpoint without a manifest never happened: the loader skips
+    a newer manifest-less dir (crash mid-save) and restores the last
+    complete step; asking for the torn step explicitly fails."""
+    root = str(tmp_path)
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    _save_world(root, 1, tree, 2, meta={"commit": 1})
+
+    # simulate a crash mid-save of step 2: rank 0's shard landed, rank
+    # 1's never did, and no MANIFEST was committed
+    payload, _ = ckpt_lib.snapshot_tree({"w": tree["w"] * 2}, 0, 2)
+    sharded_lib.write_shard(root, 2, payload)
+    assert not manifest_lib.is_complete(root, 2)
+
+    assert ckpt_lib.latest_complete_step(root) == 1
+    step, restored, _ = ckpt_lib.restore_sharded(
+        root, {"w": np.zeros(8, np.float32)})
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], tree["w"])
+    with pytest.raises(FileNotFoundError, match="incomplete/torn"):
+        ckpt_lib.restore_sharded(root, {"w": np.zeros(8, np.float32)},
+                                 step=2)
+
+    # GC: the torn dir is NEWER than the newest complete step — it may
+    # be an in-flight save, so retention must leave it alone...
+    assert ckpt_lib.retention_gc(root, keep=5) == []
+    assert os.path.isdir(manifest_lib.step_dir(root, 2))
+    # ...but once a newer step commits, the torn dir is dead debris
+    _save_world(root, 3, tree, 2)
+    assert 2 in ckpt_lib.retention_gc(root, keep=5)
+    assert not os.path.isdir(manifest_lib.step_dir(root, 2))
+
+
+def test_crc_detects_corrupt_shard(tmp_path):
+    root = str(tmp_path)
+    _save_world(root, 1, {"w": np.arange(64, dtype=np.float32)}, 2)
+    path = sharded_lib.shard_path(root, 1, 1, 2)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(ckpt_lib.ShardValidationError, match="CRC32"):
+        ckpt_lib.restore_sharded(root, {"w": np.zeros(64, np.float32)},
+                                 step=1)
+    # with no explicit step and nothing to fall back to, still an error
+    with pytest.raises(ValueError, match="failed validation"):
+        ckpt_lib.restore_sharded(root, {"w": np.zeros(64, np.float32)})
+
+
+def test_retention_gc_keeps_newest_complete(tmp_path):
+    root = str(tmp_path)
+    tree = {"w": np.ones(4, np.float32)}
+    for s in (1, 2, 3, 4):
+        _save_world(root, s, tree, 1)
+    ckpt_lib.retention_gc(root, keep=2)
+    assert ckpt_lib.list_complete_steps(root) == [3, 4]
+
+
+def test_retention_gc_spares_inflight_dirs_after_fallback(tmp_path):
+    """After a fallback restore past a damaged newest step, resumed
+    training re-uses LOWER step numbers: a manifest-less dir below the
+    newest complete step whose mtime postdates that step's commit is an
+    in-flight save and must survive GC; aged behind the commit time it
+    is dead debris again."""
+    root = str(tmp_path)
+    tree = {"w": np.ones(4, np.float32)}
+    _save_world(root, 50, tree, 1, meta={"commit": 50})
+    # a peer is writing step 42 RIGHT NOW (post-fallback numbering)
+    payload, _ = ckpt_lib.snapshot_tree(tree, 0, 2)
+    sharded_lib.write_shard(root, 42, payload)
+    assert ckpt_lib.retention_gc(root, keep=5) == []
+    assert os.path.isdir(manifest_lib.step_dir(root, 42))
+    # age the dir behind the newest commit: now it is a dead torn write
+    t50 = float(manifest_lib.read_manifest(root, 50)["time"])
+    os.utime(manifest_lib.step_dir(root, 42), (t50 - 10, t50 - 10))
+    assert 42 in ckpt_lib.retention_gc(root, keep=5)
+    assert not os.path.isdir(manifest_lib.step_dir(root, 42))
+
+
+def test_stale_ack_cleared_on_resave(tmp_path):
+    """Re-saving a torn step (restore + resume re-uses the step number)
+    must not let a peer's barrier consume last incarnation's .ok."""
+    root = str(tmp_path)
+    payload, _ = ckpt_lib.snapshot_tree({"w": np.ones(4, np.float32)}, 0, 2)
+    sharded_lib.write_shard(root, 1, payload)  # torn: ok exists, no manifest
+    ok = os.path.join(manifest_lib.step_dir(root, 1),
+                      manifest_lib.ok_name(0, 2))
+    assert os.path.isfile(ok)
+    manifest_lib.clear_stale_ack(root, 1, 0, 2)
+    assert not os.path.isfile(ok)
+    # re-entering a manifest-COMPLETE step (a fallback restore resumed
+    # below a damaged newest step) invalidates the old manifest too —
+    # the dir is torn again, so no barrier can pair stale acks with it
+    _save_world(root, 3, {"w": np.ones(4, np.float32)}, 1)
+    manifest_lib.clear_stale_ack(root, 3, 0, 1)
+    assert not manifest_lib.is_complete(root, 3)
+    assert not os.path.isfile(os.path.join(
+        manifest_lib.step_dir(root, 3), manifest_lib.ok_name(0, 1)))
+
+
+def test_resave_of_damaged_complete_step_invalidates_old_manifest(tmp_path):
+    """The full fallback → re-save cycle: the newest complete step rots,
+    restore falls back one step, resumed training re-reaches the SAME
+    step number. The re-save's clear must tear the damaged manifest
+    down — otherwise the commit barrier is satisfied instantly by the
+    old acks and a fresh manifest silently mixes old and new shards —
+    and the new save then commits a consistent step."""
+    root = str(tmp_path)
+    _save_world(root, 9, {"w": np.ones(4, np.float32)}, 2,
+                meta={"commit": 9})
+    _save_world(root, 10, {"w": np.full(4, 2.0, np.float32)}, 2,
+                meta={"commit": 10})
+    path = sharded_lib.shard_path(root, 10, 1, 2)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    step, _, _ = ckpt_lib.restore_sharded(root, {"w": np.zeros(4,
+                                                               np.float32)})
+    assert step == 9
+    # resumed training re-enters step 10 (each rank clears on save entry)
+    manifest_lib.clear_stale_ack(root, 10, 0, 2)
+    assert not manifest_lib.is_complete(root, 10)
+    tree_new = {"w": np.full(4, 3.0, np.float32)}
+    _save_world(root, 10, tree_new, 2, meta={"commit": 10})
+    s, restored, meta = ckpt_lib.restore_sharded(
+        root, {"w": np.zeros(4, np.float32)})
+    assert s == 10 and meta == {"commit": 10}
+    np.testing.assert_array_equal(restored["w"], tree_new["w"])
+
+
+def test_legacy_single_file_checkpoints_still_restore(tmp_path):
+    """checkpoint.py keeps its public API as a compatibility shim; the
+    pre-subsystem format round-trips and the directory fsync / prune
+    path leaves complete files alone while sweeping stale tmp debris."""
+    from horovod_tpu import checkpoint
+    d = str(tmp_path)
+    checkpoint.write_checkpoint(d, 1, {"w": np.ones(2, np.float32)})
+    checkpoint.write_checkpoint(d, 2, {"w": np.ones(2, np.float32) * 2})
+    # stale tmp debris (crashed write) older than the newest step...
+    open(os.path.join(d, "ckpt-1.msgpack.tmp"), "wb").write(b"junk")
+    # ...and a NEWER tmp that may be another rank's in-flight write
+    open(os.path.join(d, "ckpt-9.msgpack.tmp"), "wb").write(b"junk")
+    checkpoint.write_checkpoint(d, 3, {"w": np.ones(2, np.float32) * 3},
+                                keep=2)
+    assert checkpoint.list_steps(d) == [2, 3]
+    assert not os.path.exists(os.path.join(d, "ckpt-1.msgpack.tmp"))
+    assert os.path.exists(os.path.join(d, "ckpt-9.msgpack.tmp"))
+    params, _opt, _meta = checkpoint.restore_checkpoint(
+        d, 3, {"w": np.zeros(2, np.float32)})
+    np.testing.assert_array_equal(params["w"], 3.0)
+
+
+# ---- snapshot-offload: the stall is the copy, not the write -----------
+
+
+def _big_tree(mb=4):
+    rng = np.random.default_rng(0)
+    n = mb * (1 << 20) // 4 // 4
+    return {f"p{i}": rng.standard_normal(n).astype(np.float32)
+            for i in range(4)}
+
+
+def test_async_blocking_small_fraction_of_sync_write(tmp_path):
+    """The acceptance bound: per-save training-thread blocking during an
+    async save — read from the ``hvd_ckpt_blocking_seconds`` metric —
+    must be < 25% of the synchronous ``write_checkpoint`` wall time for
+    the same state. (On this CPU the ratio is ~1%; 25% is the contract.)
+
+    Wall-clock bounds on shared CI flake when an fsync stalls the
+    background write into the next save's ``max_inflight`` budget wait
+    (a REAL stall the metric must report, but not a subsystem bug), so
+    the timing bound gets up to 3 attempts; the structural asserts —
+    every save really committed — hold on every attempt."""
+    from horovod_tpu import checkpoint
+    from horovod_tpu.telemetry import instruments
+    from horovod_tpu.telemetry.registry import MetricsRegistry
+
+    tree = _big_tree(mb=4)
+    ratios = []
+    for attempt in range(3):
+        root = tmp_path / f"a{attempt}"
+        t0 = time.perf_counter()
+        checkpoint.write_checkpoint(str(root / "sync"), 1, tree)
+        sync_s = time.perf_counter() - t0
+
+        reg = MetricsRegistry()
+        ck = ckpt_lib.AsyncCheckpointer(str(root / "async"), keep=2,
+                                        rank=0, world=1, registry=reg)
+        for step in (1, 2, 3):
+            ck.save(step, tree)
+            # training steps run here in a real job; the background
+            # write overlaps them (saving back-to-back with no gap would
+            # measure the max_inflight budget stall instead — see the
+            # budget test)
+            time.sleep(max(2 * sync_s, 0.05))
+        ck.flush()
+        ck.close()
+        hist = reg.histogram(instruments.CKPT_BLOCKING_SECONDS, "")
+        assert hist.count == 3
+        # the full save (overlapped) really did the write + commit
+        assert reg.histogram(instruments.CKPT_SAVE_SECONDS, "").count == 3
+        assert ckpt_lib.list_complete_steps(str(root / "async")) == [2, 3]
+        mean_blocking = hist.sum / hist.count
+        ratios.append(mean_blocking / sync_s)
+        if mean_blocking < 0.25 * sync_s:
+            return
+    pytest.fail(f"async saves blocked >= 25% of the sync write on all 3 "
+                f"attempts (blocking/sync ratios {ratios}) — the stall "
+                "must be the copy, not the write")
+
+
+def test_background_failure_surfaces_on_flush(tmp_path):
+    # the checkpoint root "directory" is a regular file: the background
+    # mkdir/write must fail, and the failure must reach the trainer
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    ck = ckpt_lib.AsyncCheckpointer(str(blocker / "sub"), rank=0, world=1)
+    ck.save(1, {"w": np.ones(4, np.float32)})
+    with pytest.raises(RuntimeError, match="background checkpoint"):
+        ck.flush()
+    ck.close()
+
+
+def test_snapshot_failure_returns_budget_slot(tmp_path):
+    """A snapshot that dies on the TRAINING thread (before any job is
+    queued) must give its in-flight budget slot back — otherwise the
+    next save() parks in the budget wait forever (nothing will ever
+    decrement) and the trailing flush() deadlocks the trainer."""
+    class _Poison:
+        def __array__(self, *a, **kw):
+            raise RuntimeError("buffer gone")
+
+    ck = ckpt_lib.AsyncCheckpointer(str(tmp_path), max_inflight=1,
+                                    rank=0, world=1)
+    with pytest.raises(RuntimeError, match="buffer gone"):
+        ck.save(1, {"w": _Poison()})
+    # the slot came back: a healthy save must neither block nor inherit
+    # a phantom in-flight entry
+    ck.save(2, {"w": np.ones(4, np.float32)})
+    ck.flush()
+    ck.close()
+    assert ckpt_lib.list_complete_steps(str(tmp_path)) == [2]
+
+
+def test_restore_falls_back_past_unrestorable_newest_step(tmp_path):
+    """Torn-write philosophy, applied to reads: when the NEWEST
+    manifest-complete step is unrestorable — a shard fails its manifest
+    CRC (disk rot, or a manifest paired with a stale phase-1 ack by the
+    crash-adjacent re-save race) or a shard file is simply gone — the
+    default restore falls back to the previous complete step instead of
+    stranding the job. An EXPLICIT step still fails loudly, and so does
+    damage hitting every step (nothing left to fall back to)."""
+    root = str(tmp_path)
+    tree5 = {"w": np.arange(8, dtype=np.float32)}
+    _save_world(root, 5, tree5, 2, meta={"commit": 5})
+    _save_world(root, 10, {"w": np.arange(8, dtype=np.float32) * 2}, 2)
+
+    # newest step's shard 1 is corrupt (CRC mismatch vs its manifest)
+    path = sharded_lib.shard_path(root, 10, 1, 2)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+    step, restored, meta = ckpt_lib.restore_sharded(
+        root, {"w": np.zeros(8, np.float32)})
+    assert step == 5 and meta == {"commit": 5}
+    np.testing.assert_array_equal(restored["w"], tree5["w"])
+    with pytest.raises(ckpt_lib.ShardValidationError, match="CRC32"):
+        ckpt_lib.restore_sharded(root, {"w": np.zeros(8, np.float32)},
+                                 step=10)
+
+    # a MISSING shard file falls back the same way...
+    os.remove(path)
+    step, _, _ = ckpt_lib.restore_sharded(root,
+                                          {"w": np.zeros(8, np.float32)})
+    assert step == 5
+    # ...and when every complete step is damaged, restore fails loudly
+    os.remove(sharded_lib.shard_path(root, 5, 0, 2))
+    with pytest.raises(ValueError, match="failed validation"):
+        ckpt_lib.restore_sharded(root, {"w": np.zeros(8, np.float32)})
+
+
+def test_max_inflight_budget_blocks_and_is_metered(tmp_path):
+    """With max_inflight=1 a second save must wait for the first commit,
+    and that wait is charged to the blocking metric (a budget stall is a
+    real training stall)."""
+    from horovod_tpu.telemetry import instruments
+    from horovod_tpu.telemetry.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    tree = _big_tree(mb=2)
+    ck = ckpt_lib.AsyncCheckpointer(str(tmp_path), max_inflight=1,
+                                    rank=0, world=1, registry=reg)
+    b1 = ck.save(1, tree)
+    b2 = ck.save(2, tree)  # queued while 1 is still serializing
+    ck.flush()
+    ck.close()
+    hist = reg.histogram(instruments.CKPT_BLOCKING_SECONDS, "")
+    assert hist.count == 2 and hist.sum >= b1 + b2 - 1e-6
+    assert ckpt_lib.latest_complete_step(str(tmp_path)) == 2
+
+
+def test_snapshot_payload_copies_host_numpy():
+    """The payload handed to the background writer must be decoupled
+    from live state: numpy-backed state (device_get is identity on it)
+    mutated in place during the overlapped write must not reach the
+    bytes being serialized — a torn serialization would still CRC as
+    valid and commit a state no training step ever produced."""
+    w = np.ones(8, np.float32)
+    payload, _ = ckpt_lib.snapshot_tree({"w": w}, 0, 1)
+    assert not np.shares_memory(payload["repl"]["0"], w)
+    w += 1  # the training step the background write overlaps
+    np.testing.assert_array_equal(payload["repl"]["0"], 1.0)
+
+    import horovod_tpu.elastic.state as state_mod
+    st = state_mod.JaxState(w=w)
+    cap = st._capture()
+    assert not np.shares_memory(cap["w"], st.w)
+
+
+def test_flush_timeout_zero_means_dont_wait(tmp_path):
+    """flush(timeout=0) is 'abandon immediately', not 'wait forever':
+    HOROVOD_CKPT_RESET_TIMEOUT=0 must not park elastic recovery on a
+    commit barrier a dead peer already broke."""
+    ck = ckpt_lib.AsyncCheckpointer(str(tmp_path), rank=0, world=2,
+                                    barrier_timeout=5.0)
+    ck.save(1, {"w": np.ones(4, np.float32)})  # parks: no peer shard
+    with pytest.raises(TimeoutError, match="still in"):
+        ck.flush(timeout=0)
+    ck.abandon()
+    ck._thread.join(timeout=30)
+
+
+def test_abandon_drops_queued_saves(tmp_path):
+    """abandon() must DROP queued-but-unwritten saves, not drain them: a
+    shard the dead writer lands minutes later could pair with a manifest
+    the post-reset world commits for the same step. world=2 with no
+    peer: save 1 parks in the commit barrier mid-write, save 2 sits
+    queued behind it; after abandon(), step 2's dir must never appear."""
+    root = str(tmp_path)
+    tree = {"w": np.ones(4, np.float32)}
+    ck = ckpt_lib.AsyncCheckpointer(root, max_inflight=2, rank=0, world=2,
+                                    barrier_timeout=1.0)
+    ck.save(1, tree)
+    ok1 = os.path.join(manifest_lib.step_dir(root, 1),
+                       manifest_lib.ok_name(0, 2))
+    deadline = time.monotonic() + 10
+    while not os.path.isfile(ok1) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert os.path.isfile(ok1), "save 1 never reached its mid-write park"
+    ck.save(2, tree)
+    ck.abandon()
+    ck._thread.join(timeout=30)
+    assert not ck._thread.is_alive()
+    assert os.path.isdir(manifest_lib.step_dir(root, 1))  # was mid-write
+    assert not os.path.isdir(manifest_lib.step_dir(root, 2))
+    with ck._lock:
+        assert ck._inflight == 0
+
+
+# ---- elastic integration: JaxState through the subsystem --------------
+
+
+def test_jax_state_commit_restore_and_flush_on_reset(tmp_path, monkeypatch):
+    """JaxState commits land as sharded manifest-complete checkpoints at
+    the checkpoint_every cadence; on_reset (the pre-rendezvous hook)
+    flushes in-flight saves; a fresh JaxState restores commit + meta.
+    Single process standing in for world=1 (an initialized 8-device hvd
+    would make the commit barrier wait for 8 shards)."""
+    import horovod_tpu as hvd_mod
+    import horovod_tpu.elastic as elastic
+    hvd_mod.shutdown()
+    monkeypatch.delenv("HOROVOD_RANK", raising=False)
+    monkeypatch.delenv("HOROVOD_SIZE", raising=False)
+
+    d = str(tmp_path)
+    state = elastic.JaxState(directory=d, keep=5, checkpoint_every=2,
+                             w=np.zeros(4, np.float32))
+    for c in range(1, 5):
+        state.w = state.w + 1
+        state.commit()
+        state.on_reset()  # must force any async save to durability
+        complete = ckpt_lib.list_complete_steps(d)
+        assert complete == [s for s in range(1, c + 1) if s % 2 == 0]
+
+    fresh = elastic.JaxState(directory=d, keep=5,
+                             w=np.zeros(4, np.float32))
+    fresh.restore()
+    assert fresh._commit_count == 4
+    np.testing.assert_array_equal(fresh.w, 4.0)
+    state.flush()
+    fresh.flush()
+
+
+def test_sync_adopts_roots_commit_count(monkeypatch):
+    """After a membership change the synced trees are the root's commit;
+    the commit COUNTER must ride along — a disk-restored newcomer sits
+    at the on-disk count while survivors are in-memory ahead, and ranks
+    that disagree would write their next shards under DIFFERENT step
+    numbers, a commit barrier that can never complete. Single process:
+    the patched collective plane hands back the root's counter."""
+    import horovod_tpu.elastic.state as state_mod
+
+    roots_seen = []
+
+    def fake_broadcast(tree, root):
+        roots_seen.append(root)
+        if isinstance(tree, np.ndarray) and tree.shape == ():
+            return np.asarray(7, np.int64)  # the root's counter
+        return tree
+
+    monkeypatch.setattr(state_mod, "_broadcast_tree", fake_broadcast)
+    monkeypatch.setattr(state_mod, "_elect_root",
+                        lambda root_rank, has_commit: 1)
+
+    st = state_mod.JaxState(w=np.zeros(2, np.float32))
+    st._saved_state = {"w": np.ones(2, np.float32)}  # a prior commit
+    st._commit_count = 4  # disk-restored lag behind the survivors
+    assert st.sync() == 1
+    assert st._commit_count == 7
+    assert roots_seen == [1, 1]  # trees, then the counter — same root
+
+
+def test_elastic_train_loop_checkpoint_cadence(tmp_path, monkeypatch):
+    """``elastic_train_loop(checkpoint_every=3)``: the entry sync's
+    baseline save is commit 1, the 4 training steps commit 2..5; disk
+    sees [3] by cadence plus the FORCED final commit [5] — and the
+    forced commit must not clobber the cadence (an elastic retry
+    re-enters the loop with the same state object)."""
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd_mod
+    import horovod_tpu.elastic as elastic
+    from horovod_tpu.training import TrainState, elastic_train_loop
+    hvd_mod.shutdown()
+    monkeypatch.delenv("HOROVOD_RANK", raising=False)
+    monkeypatch.delenv("HOROVOD_SIZE", raising=False)
+
+    tx = optax.sgd(0.2)
+    params = {"w": jnp.zeros(())}
+    ts = TrainState(params=params, opt_state=tx.init(params),
+                    batch_stats={}, step=jnp.zeros((), jnp.int32))
+
+    def train_step(state, inputs, labels):
+        del inputs, labels
+        grads = {"w": 2 * (state.params["w"] - 3.0)}
+        updates, opt_state = tx.update(grads, state.opt_state,
+                                       state.params)
+        return TrainState(params=optax.apply_updates(state.params,
+                                                     updates),
+                          opt_state=opt_state, batch_stats={},
+                          step=state.step + 1), \
+            (state.params["w"] - 3.0) ** 2
+
+    state = elastic.JaxState(directory=str(tmp_path), train_state=ts)
+    final = elastic_train_loop(state, train_step,
+                               lambda step: (None, None), num_steps=4,
+                               commit_every=1, checkpoint_every=3)
+    assert int(final.step) == 4
+    assert ckpt_lib.list_complete_steps(str(tmp_path)) == [3, 5]
+    assert state.checkpoint_every == 3  # cadence survives the final save
+    state._ckpt.close()
+
+
+def _ckpt_every_worker(ckpt_dir, sync_dir):
+    def run():
+        import time as _time
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+        from horovod_tpu import checkpoint
+        from horovod_tpu import ckpt as _ckpt
+        from horovod_tpu.telemetry import get_registry, instruments
+        hvd.init()
+        rank = hvd.rank()
+        rng = np.random.default_rng(rank)
+        w = rng.standard_normal(1 << 19).astype(np.float32)  # 2 MB/rank
+
+        # the synchronous baseline for THE SAME state (rank-local dir)
+        t0 = _time.perf_counter()
+        checkpoint.write_checkpoint(f"{sync_dir}/r{rank}", 1, {"w": w})
+        sync_s = _time.perf_counter() - t0
+
+        state = hvd.elastic.JaxState(directory=ckpt_dir, keep=5,
+                                     checkpoint_every=2, w=w)
+        for _ in range(4):
+            w = w + hvd.allreduce(np.ones_like(w))
+            state.w = w
+            state.commit()
+            _time.sleep(0.3)  # the training work the write overlaps
+        state.flush()
+        hist = get_registry().histogram(instruments.CKPT_BLOCKING_SECONDS,
+                                        "")
+        steps = _ckpt.list_complete_steps(ckpt_dir)
+        state._ckpt.close()
+        return (sync_s, hist.sum, hist.count, steps)
+    return run
+
+
+def test_2rank_checkpoint_every_blocking_under_25pct(tmp_path):
+    """The ISSUE 5 acceptance run: 2 CPU ranks committing through
+    ``checkpoint_every=2``; per-step blocking time during the async
+    saves (``hvd_ckpt_blocking_seconds``) stays under 25% of each
+    rank's synchronous ``write_checkpoint`` baseline, and only every
+    2nd commit reached disk. The structural asserts hold on every
+    attempt; the wall-clock bound (flaky under shared-CI fsync stalls)
+    gets up to 3 attempts."""
+    worst = []
+    for attempt in range(3):
+        ckpt_dir = str(tmp_path / f"ck{attempt}")
+        sync_dir = str(tmp_path / f"sync{attempt}")
+        results = api.run(_ckpt_every_worker(ckpt_dir, sync_dir), np=2,
+                          extra_env={"JAX_PLATFORMS": "cpu",
+                                     "HOROVOD_CKPT_TIMEOUT": "60"})
+        ratios = []
+        for rank, (sync_s, blocking_sum, n_saves, steps) \
+                in enumerate(results):
+            assert n_saves == 2, f"rank {rank}: 4 commits -> 2 disk saves"
+            assert steps == [2, 4]
+            ratios.append(blocking_sum / n_saves / sync_s)
+        worst.append(max(ratios))
+        if max(ratios) < 0.25:
+            return
+    pytest.fail(f"some rank's async blocking was >= 25% of its sync "
+                f"write on all 3 attempts (worst blocking/sync ratio "
+                f"per attempt: {worst})")
+
+
+def test_manifest_kv_ack_is_best_effort(tmp_path, monkeypatch):
+    """With a rendezvous KV configured but unreachable, commits must
+    still succeed — durability never depends on the KV ack."""
+    monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_PORT", "1")  # nothing there
+    man = _save_world(str(tmp_path), 1, {"w": np.ones(2, np.float32)}, 1)
+    assert man["step"] == 1
+    assert ckpt_lib.latest_complete_step(str(tmp_path)) == 1
+
+
+def test_doctor_reports_interrupted_save():
+    """A flight-recorder dump holding a ckpt B without its E is surfaced
+    by the doctor as an interrupted save (the post-crash story: restore
+    falls back to the last complete manifest)."""
+    from horovod_tpu.diag import doctor
+    dump = {"flightrec": 1, "rank": 0, "size": 1, "collective_seq": 3,
+            "last_completed_seq": 3, "open_collectives": {},
+            "dump_reasons": ["sigterm"], "digest": {},
+            "events": [
+                {"k": "ckpt", "t": 1.0, "ph": "B", "step": 4, "rank": 0},
+                {"k": "ckpt", "t": 1.2, "ph": "E", "step": 4, "ok": True},
+                {"k": "ckpt", "t": 2.0, "ph": "B", "step": 5, "rank": 0},
+            ]}
+    report = doctor.diagnose({0: dump})
+    assert report["interrupted_saves"] == {0: [5]}
+    text = doctor.format_report(report)
+    assert "INTERRUPTED CHECKPOINT SAVE" in text
+    assert "step(s) [5]" in text
+    # serializable (the launcher writes reports as json)
+    json.dumps(report)
+
+    # B/E pairing is by EVENT ORDER, not step membership: a step whose
+    # first save failed and was then re-begun (the torn-step re-save
+    # flow) is open again — an old E must not mask the later B
+    dump["events"] = [
+        {"k": "ckpt", "t": 1.0, "ph": "B", "step": 4, "rank": 0},
+        {"k": "ckpt", "t": 1.2, "ph": "E", "step": 4, "ok": False},
+        {"k": "ckpt", "t": 2.0, "ph": "B", "step": 4, "rank": 0},
+    ]
+    assert doctor.diagnose({0: dump})["interrupted_saves"] == {0: [4]}

@@ -217,10 +217,14 @@ def test_jax_state_commit_restore_sync_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(state.params["w"]), 1.0)
     assert int(state.step) == 0
 
-    # disk-backed: a FRESH process (new object) restores the last commit
+    # disk-backed: a FRESH process (new object) restores the last commit.
+    # Commits are ASYNC now (horovod_tpu/ckpt): the old incarnation must
+    # flush before another reader consumes the directory — exactly what
+    # the elastic loop does before every re-rendezvous (State.on_reset)
     state.params = {"w": jnp.full((3,), 2.0), "b": jnp.asarray(4.0)}
     state.step = np.int64(3)
     state.commit()
+    state.flush()
     fresh = JaxState(directory=str(tmp_path),
                      params={"w": jnp.zeros((3,)), "b": jnp.zeros(())},
                      step=np.int64(0))
@@ -236,10 +240,24 @@ def test_jax_state_commit_restore_sync_roundtrip(tmp_path):
 
 def test_jax_state_rank_gate_blocks_nonzero_rank_writes(tmp_path,
                                                         monkeypatch):
+    """Under the sharded subsystem every rank writes its OWN shard —
+    but the MANIFEST (what makes a checkpoint exist) is still rank 0's
+    alone: a lone rank 1 leaves only a torn, restore-invisible dir, and
+    its flush surfaces the missing phase-2 commit as an error."""
     monkeypatch.setenv("HOROVOD_RANK", "1")
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    monkeypatch.setenv("HOROVOD_CKPT_TIMEOUT", "1")
+    from horovod_tpu import ckpt as ckpt_lib
+    from horovod_tpu.ckpt import manifest as manifest_lib
     state = JaxState(directory=str(tmp_path), x=np.asarray(1.0))
     state.commit()
-    assert os.listdir(str(tmp_path)) == []  # only rank 0 writes
+    with pytest.raises(RuntimeError, match="MANIFEST"):
+        state.flush()  # rank 0 never committed phase 2
+    sdir = manifest_lib.step_dir(str(tmp_path), 1)
+    assert os.path.isfile(os.path.join(sdir, manifest_lib.shard_name(1, 2)))
+    assert not manifest_lib.is_complete(str(tmp_path), 1)
+    assert ckpt_lib.latest_complete_step(str(tmp_path)) is None
+    state._ckpt.close()
 
 
 # ---------------------------------------------------------------------------

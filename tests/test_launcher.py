@@ -336,6 +336,83 @@ def test_sigkilled_rank_diagnosed_by_doctor(tmp_path):
     assert any(x == (5, "allreduce") for x in stuck)
 
 
+def test_sigkill_mid_save_resumes_from_last_manifest(tmp_path):
+    """The ISSUE 5 checkpoint e2e: a 2-rank run commits through the
+    async sharded subsystem; rank 1 SIGKILLs itself right after
+    initiating commit 3 — its 16 MB shard write is still in flight, so
+    step 3 can never reach a manifest. The auto-doctor must name the
+    interrupted save; a relaunch must resume from the last COMMITTED
+    manifest (step 2), re-save the torn step, and finish with state
+    identical to an uninterrupted run."""
+    from horovod_tpu import ckpt as ckpt_lib
+    from horovod_tpu.ckpt import manifest as manifest_lib
+
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import os, signal
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        rank = hvd.rank()
+        ckpt_dir = os.environ["CKPT_DIR"]
+        kill_at = int(os.environ.get("KILL_AT", "0"))
+        state = hvd.elastic.JaxState(
+            directory=ckpt_dir, keep=10,
+            w=np.zeros(1 << 22, np.float32))  # 16 MB: the write is slow
+        state.restore()  # newest manifest-complete commit, or fresh
+        start = state._commit_count
+        print(f"START {rank} {start}", flush=True)
+        for c in range(start + 1, 7):
+            state.w = state.w + np.float32(
+                np.asarray(hvd.allreduce(np.ones(4, np.float32)))[0])
+            state.commit()
+            if kill_at and rank == 1 and c == kill_at:
+                # the commit is ASYNC: our shard for step c is still
+                # being serialized in the background — a SIGKILL now is
+                # a save torn mid-write, no cleanup, no dump
+                os.kill(os.getpid(), signal.SIGKILL)
+        state.flush()
+        print(f"DONE {rank} {float(np.asarray(state.w)[0]):.1f}",
+              flush=True)
+    """))
+    ckpt_dir = tmp_path / "ck"
+    out_dir = tmp_path / "out"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["CKPT_DIR"] = str(ckpt_dir)
+    env["KILL_AT"] = "3"
+    rv = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--output-dir", str(out_dir), sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=150)
+    assert rv.returncode == 1
+    assert "exited with code 137" in rv.stderr
+    # commits 1 and 2 are manifest-complete; 3 is a torn, invisible dir
+    assert ckpt_lib.latest_complete_step(str(ckpt_dir)) == 2
+    assert os.path.isdir(manifest_lib.step_dir(str(ckpt_dir), 3))
+    assert not manifest_lib.is_complete(str(ckpt_dir), 3)
+    # the auto-doctor names the save the crash interrupted (rank 0's
+    # dump holds a ckpt B for step 3 whose commit never happened)
+    assert "doctor report" in rv.stderr
+    assert "INTERRUPTED CHECKPOINT SAVE" in rv.stderr
+    assert "step(s) [3]" in rv.stderr
+
+    # relaunch: resume from the last COMMITTED manifest and run out
+    env["KILL_AT"] = "0"
+    rv2 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=150)
+    assert rv2.returncode == 0, rv2.stderr[-2000:]
+    for rank in (0, 1):
+        assert f"START {rank} 2" in rv2.stdout  # resumed at commit 2
+        assert f"DONE {rank} 6.0" in rv2.stdout  # identical final state
+    # the torn step was re-saved and committed on the way through
+    assert manifest_lib.is_complete(str(ckpt_dir), 3)
+    assert ckpt_lib.latest_complete_step(str(ckpt_dir)) == 6
+
+
 def test_hvdrun_doctor_flag(tmp_path):
     """hvdrun --doctor <logdir> == python -m horovod_tpu.diag.doctor."""
     from horovod_tpu.diag.recorder import FlightRecorder
