@@ -150,6 +150,25 @@ def np_nbytes(x):
     return a.size * a.dtype.itemsize
 
 
+def _record_step_time(args, step, state, images, labels, result, suffix):
+    """Shared timing summary for the comparison modes: median
+    slope-window step time into ``step_ms_<suffix>`` plus the
+    conservative-bound count — one implementation so --overlap and
+    --compression can never report inconsistently computed numbers."""
+    from horovod_tpu.utils.benchmarks import repeat_throughput
+
+    runs = repeat_throughput(step, state, images, labels,
+                             max(args.num_warmup - 1, 0),
+                             args.num_iters, args.repeats)
+    dts = sorted(float(r[1]) for r in runs)
+    dt = dts[len(dts) // 2]
+    result[f"step_ms_{suffix}"] = round(1000 * dt / args.num_iters, 2)
+    n_bound = sum(1 for r in runs
+                  if getattr(r[1], "upper_bound", False))
+    if n_bound:
+        result[f"upper_bound_windows_{suffix}"] = n_bound
+
+
 def overlap_comparison(args):
     """``--overlap``: step time for {baseline fused-allreduce, overlapped
     reduce-scatter pipeline, overlapped + ZeRO-1 sharded update} on the
@@ -161,8 +180,7 @@ def overlap_comparison(args):
 
     import horovod_tpu as hvd
     from horovod_tpu import training
-    from horovod_tpu.utils.benchmarks import (make_model, repeat_throughput,
-                                              synthetic_batch)
+    from horovod_tpu.utils.benchmarks import make_model, synthetic_batch
 
     hvd.init()
     ndev = hvd.num_devices()
@@ -195,16 +213,7 @@ def overlap_comparison(args):
         state, _ = step(state, images, labels)
         result[f"opt_state_bytes_per_device_{name}"] = (
             _opt_state_bytes_per_device(state.opt_state))
-        runs = repeat_throughput(step, state, images, labels,
-                                 max(args.num_warmup - 1, 0),
-                                 args.num_iters, args.repeats)
-        dts = sorted(float(r[1]) for r in runs)
-        dt = dts[len(dts) // 2]
-        result[f"step_ms_{name}"] = round(1000 * dt / args.num_iters, 2)
-        n_bound = sum(1 for r in runs
-                      if getattr(r[1], "upper_bound", False))
-        if n_bound:
-            result[f"upper_bound_windows_{name}"] = n_bound
+        _record_step_time(args, step, state, images, labels, result, name)
     base = result.get("opt_state_bytes_per_device_baseline_fused_ar", 0)
     z1 = result.get("opt_state_bytes_per_device_overlap_rs_zero1", 0)
     if base and z1:
@@ -219,6 +228,84 @@ def overlap_comparison(args):
     print(json.dumps(result))
 
 
+def compression_comparison(args):
+    """``--compression``: the overlapped bucket pipeline at each requested
+    wire format on the same workload — step time, bytes-on-wire, and the
+    logical/wire compression ratio per format (docs/PERFORMANCE.md,
+    "Wire compression"). Bytes come from the telemetry counters, which
+    advance at TRACE time on the compiled path: the delta across the
+    first (tracing) step call is the wire volume baked into one compiled
+    step. One JSON line, same contract as the headline bench."""
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import telemetry, training
+    from horovod_tpu.ops import compression as compression_lib
+    from horovod_tpu.telemetry import instruments
+    from horovod_tpu.utils.benchmarks import make_model, synthetic_batch
+
+    formats = list(args.compression) or ["none", "bf16", "fp8", "int8"]
+    for f in formats:
+        compression_lib.by_name(f)  # fail fast on a typo
+    if "none" not in formats:
+        formats = ["none"] + formats  # ratio/speedup need the baseline
+
+    hvd.init()
+    ndev = hvd.num_devices()
+    K = args.accum_steps
+    global_batch = args.batch_size * ndev
+    images, labels = synthetic_batch(global_batch, args.image_size)
+    reg = telemetry.get_registry()
+
+    def wire_totals():
+        # bucket_* labels only: the pipeline's bucket counters aggregate
+        # the primitive dispatches they wrap (alltoall/allgather/...),
+        # which record under their own op labels too — summing every
+        # label would double-count the same bytes
+        out = []
+        for name in (instruments.COLLECTIVE_BYTES,
+                     instruments.COLLECTIVE_LOGICAL_BYTES):
+            fam = reg.get(name)
+            s = fam.sample() if fam is not None else {}
+            if not isinstance(s, dict):
+                out.append(float(s or 0.0))
+                continue
+            out.append(float(sum(
+                v for k, v in s.items()
+                if any(str(part).startswith("bucket_") for part in k))))
+        return out
+
+    result = {"metric": f"{args.model}_wire_compression_step_ms",
+              "unit": "ms/step", "accum_steps": K, "devices": ndev,
+              "per_chip_batch": args.batch_size, "repeats": args.repeats}
+    for name in formats:
+        model = make_model(args.model)
+        tx = hvd.DistributedOptimizer(optax.sgd(1e-3, momentum=0.9),
+                                      compression=name)
+        step = training.make_train_step(model, tx, donate=True,
+                                        accum_steps=K, overlap_grads=True)
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0),
+                                            images[:1])
+        w0, l0 = wire_totals()
+        state, _ = step(state, images, labels)  # traces + compiles
+        w1, l1 = wire_totals()
+        wire_b, logical_b = w1 - w0, l1 - l0
+        result[f"wire_bytes_per_step_{name}"] = int(wire_b)
+        result[f"logical_bytes_per_step_{name}"] = int(logical_b)
+        if wire_b > 0:
+            result[f"compression_ratio_{name}"] = round(
+                logical_b / wire_b, 3)
+        _record_step_time(args, step, state, images, labels, result, name)
+    if result.get("step_ms_none"):
+        for name in formats:
+            if name != "none" and result.get(f"step_ms_{name}"):
+                result[f"speedup_{name}_vs_none"] = round(
+                    result["step_ms_none"] / result[f"step_ms_{name}"], 3)
+    result["telemetry"] = _telemetry_block()
+    print(json.dumps(result))
+
+
 def _telemetry_block():
     """The registry snapshot for the BENCH json: collective bytes and
     bucket fill ride alongside throughput, so perf rounds can attribute
@@ -226,7 +313,7 @@ def _telemetry_block():
     from horovod_tpu import telemetry
     snap = telemetry.get_registry().snapshot()
     keep = ("horovod_collective", "horovod_bucket", "horovod_step",
-            "horovod_examples", "horovod_compile")
+            "horovod_examples", "horovod_compile", "hvd_wire")
     return {k: v for k, v in sorted(snap.items())
             if k.startswith(keep)}
 
@@ -328,14 +415,29 @@ def main():
                              "--overlap (the pipeline overlaps bucket k's "
                              "reduce-scatter with microbatch k+1's "
                              "backward)")
+    parser.add_argument("--compression", nargs="*", default=None,
+                        metavar="{none,bf16,fp8,int8}",
+                        help="run ONLY the wire-compression comparison: "
+                             "the overlapped pipeline at each listed wire "
+                             "format (bare --compression = all four), "
+                             "emitting step time, bytes-on-wire, and the "
+                             "compression ratio (docs/PERFORMANCE.md)")
     args = parser.parse_args()
     if args.repeats < 1:
         parser.error("--repeats must be >= 1")
+    if args.overlap and args.compression is not None:
+        parser.error("--overlap and --compression are separate comparison "
+                     "modes (the compression block already runs the "
+                     "overlapped pipeline); pass one of the two")
     if args.accum_steps < 1:
         parser.error("--accum-steps must be >= 1")
 
     if args.overlap:
         overlap_comparison(args)
+        return
+
+    if args.compression is not None:
+        compression_comparison(args)
         return
 
     if args.calibrate:

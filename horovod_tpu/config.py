@@ -68,6 +68,13 @@ class Config:
 
     # --- data plane tuning ---
     fusion_threshold: int = DEFAULT_FUSION_THRESHOLD
+    # Default collective wire format for DistributedOptimizer(
+    # compression=None): one of None (uncompressed), "bf16"/"fp16",
+    # "float16", "fp8_e4m3"/"fp8", "fp8_e5m2", "int8"
+    # (ops/compression.by_name). The autotuner's wire axis installs its
+    # winner here (docs/AUTOTUNE.md); an explicit compression= argument
+    # always wins over the config value.
+    wire_dtype: str = None
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     cache_capacity: int = DEFAULT_CACHE_CAPACITY
     hierarchical_allreduce: bool = False
@@ -140,6 +147,7 @@ class Config:
             rendezvous_port=_env_int("HOROVOD_GLOO_RENDEZVOUS_PORT", 0),
             fusion_threshold=_env_int(
                 "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD),
+            wire_dtype=_env_str("HOROVOD_WIRE_DTYPE"),
             cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME",
                                      DEFAULT_CYCLE_TIME_MS),
             cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY",

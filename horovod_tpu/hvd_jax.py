@@ -63,42 +63,118 @@ class HorovodOptimizer:
 
     def __init__(self, inner, op, axes, compression, threshold_bytes,
                  hierarchical, sharded_update, backward_passes_per_step):
-        import optax
-
         self.inner = inner
         self.op = op
         self.axes = axes
-        self.compression = compression
         self.threshold_bytes = threshold_bytes
         self.hierarchical = hierarchical
         self.sharded_update = sharded_update
         self.backward_passes_per_step = backward_passes_per_step
 
+        from horovod_tpu.ops import compression as compression_lib
+
+        # ``None`` defers to config.wire_dtype AT USE TIME (the config
+        # does not exist before hvd.init(), and the autotuner's wire
+        # axis may install its winner after this optimizer is built —
+        # same late binding as _hierarchical_resolved); an explicit
+        # "none"/Compression.none pins uncompressed regardless of config.
+        self._wire_forced_off = False
+        if isinstance(compression, str):
+            name = compression
+            compression = compression_lib.by_name(compression)
+            if compression is None and name is not None:
+                self._wire_forced_off = True
+        elif isinstance(compression, compression_lib.NoneCompressor):
+            self._wire_forced_off = True
+            compression = None
+        if compression is not None:
+            self._check_wire(compression)
+        self._compression = compression
+
         if sharded_update:
             if op not in (Sum, Average):
                 raise ValueError(
                     f"sharded_update supports Sum or Average, got {op!r}")
-            if compression is not None:
-                raise ValueError(
-                    "sharded_update does not compose with wire compression "
-                    "yet; drop one of the two")
             if backward_passes_per_step > 1:
                 raise ValueError(
                     "sharded_update accumulates via make_train_step("
                     "accum_steps=...) — backward_passes_per_step>1 would "
                     "stack a second accumulator on top")
-            self._transform = None
-            return
-        chained = optax.chain(
-            DistributedGradientTransform(
-                op=op, axes=axes, compression=compression,
-                threshold_bytes=threshold_bytes, hierarchical=hierarchical),
-            inner,
-        )
-        if backward_passes_per_step > 1:
-            chained = optax.MultiSteps(
-                chained, every_k_schedule=backward_passes_per_step)
-        self._transform = chained
+        self._transform = None
+        self._transform_wire = self._WIRE_UNSET
+        self._config_wire_warned = False
+
+    def _check_wire(self, compression):
+        if (getattr(compression, "chunked", False)
+                and self.op not in (Sum, Average)):
+            raise ValueError(
+                f"chunked wire format {compression.name!r} only composes "
+                f"with Sum/Average reductions (got {self.op!r}): e.g. "
+                "int8 wire + Adasum is unsupported — per-chunk scales "
+                "cannot ride Adasum's dot-product composition. Use "
+                "bf16/fp16 (cast) compression or drop the quantizer.")
+
+    @property
+    def compression(self):
+        """The resolved wire format: the explicit argument if one was
+        given, else ``config.wire_dtype`` read at access time (so an
+        optimizer built before ``hvd.init()`` / before the autotuner
+        installed its wire-axis winner still picks the config value up),
+        else ``None``. A config-derived DEFAULT that is incompatible
+        with this optimizer's op (e.g. int8 installed globally while
+        this one runs Adasum) is ignored with a warning — only an
+        EXPLICIT argument hard-errors on an unsupported combo."""
+        if self._compression is not None or self._wire_forced_off:
+            return self._compression
+        from horovod_tpu import basics
+        from horovod_tpu.ops import compression as compression_lib
+        cfg = basics._state.config
+        if cfg is None or not cfg.wire_dtype:
+            return None
+        wire = compression_lib.by_name(cfg.wire_dtype)
+        if isinstance(wire, compression_lib.NoneCompressor):
+            return None
+        if wire is not None:
+            try:
+                self._check_wire(wire)
+            except ValueError as e:
+                if not self._config_wire_warned:
+                    self._config_wire_warned = True
+                    import warnings
+                    warnings.warn(
+                        f"ignoring config.wire_dtype={cfg.wire_dtype!r} "
+                        f"for this optimizer (op={self.op!r}): {e}")
+                return None
+        return wire
+
+    _WIRE_UNSET = object()
+
+    def _ensure_transform(self):
+        """Build the chained (non-sharded) transform against the wire
+        format resolved NOW, rebuilding if the resolution has changed
+        since (init() before the autotuner installs config.wire_dtype
+        must not freeze the stale value while ``tx.compression`` reports
+        the new one). Rebuilding is safe: the chain's state structure
+        does not depend on the wire format — only the traced update
+        math changes, which is the point."""
+        wire = self.compression
+        if self._transform is None or wire is not self._transform_wire:
+            import optax
+
+            chained = optax.chain(
+                DistributedGradientTransform(
+                    op=self.op, axes=self.axes, compression=wire,
+                    threshold_bytes=self.threshold_bytes,
+                    hierarchical=self.hierarchical),
+                self.inner,
+            )
+            if self.backward_passes_per_step > 1:
+                chained = optax.MultiSteps(
+                    chained,
+                    every_k_schedule=self.backward_passes_per_step)
+            self._transform = chained
+            self._transform_wire = wire
+        return self._transform
 
     def init(self, params):
         if self.sharded_update:
@@ -108,7 +184,7 @@ class HorovodOptimizer:
                 threshold_bytes=self.threshold_bytes,
                 hierarchical=bool(self._hierarchical_resolved()))
             return zero.init(self.inner, params, plan)
-        return self._transform.init(params)
+        return self._ensure_transform().init(params)
 
     def update(self, updates, state, params=None):
         if self.sharded_update:
@@ -116,8 +192,9 @@ class HorovodOptimizer:
             if params is None:
                 raise ValueError("sharded_update needs params: "
                                  "tx.update(grads, state, params)")
-            return zero.sharded_update(self.inner, updates, state, params)
-        return self._transform.update(updates, state, params)
+            return zero.sharded_update(self.inner, updates, state, params,
+                                       wire=self.compression)
+        return self._ensure_transform().update(updates, state, params)
 
     def update_preaveraged(self, grads, state, params=None):
         """Inner update on gradients that are ALREADY reduced across the
@@ -157,7 +234,22 @@ def DistributedOptimizer(tx, op=Average, axes=None, compression=None,
     memory per device. ``tx`` must be elementwise (see the zero module
     docstring); ``init``/``update`` must then run where the mesh axes are
     bound (inside ``shard_map`` — ``training.make_train_step`` handles
-    placement and specs automatically)."""
+    placement and specs automatically).
+
+    ``compression`` picks the collective wire format: a compressor from
+    ``hvd.Compression`` (``bf16``, ``fp8_e4m3``, ``int8``, ...) or its
+    name as a string. ``None`` (default) defers to ``config.wire_dtype``
+    (``HOROVOD_WIRE_DTYPE`` / the autotuner's wire axis), which itself
+    defaults to uncompressed; pass ``Compression.none`` / ``"none"`` to
+    force uncompressed regardless of config. Compression composes with
+    ``sharded_update`` and the overlapped pipeline (``training.
+    make_train_step(overlap_grads=True)`` threads the per-bucket
+    error-feedback residual); genuinely unsupported combos — a chunked
+    quantizer with Adasum/Min/Max — raise loudly (docs/PERFORMANCE.md,
+    "Wire compression"). The config deferral binds LATE — at first use,
+    not at construction — so building the optimizer before ``hvd.init()``
+    or before the autotuner installs its winner still honors the
+    config."""
     return HorovodOptimizer(
         tx, op=op, axes=axes, compression=compression,
         threshold_bytes=threshold_bytes, hierarchical=hierarchical,

@@ -94,27 +94,49 @@ def mesh_rank(axes=None):
     return idx
 
 
-def allreduce(x, op=Average, axes=None, compression=None):
+def allreduce(x, op=Average, axes=None, compression=None,
+              logical_nbytes=None):
     """Reduce ``x`` across all shards on ``axes``; every shard receives the
     result. Reference: ``MPIAllreduce``/``NCCLAllreduce``
     (``mpi_operations.cc``, ``nccl_operations.cc:55-105``).
 
     ``compression`` (see ``horovod_tpu.ops.compression``) casts to a narrow
     wire dtype before the collective, mirroring
-    ``horovod/torch/compression.py``.
+    ``horovod/torch/compression.py``. Only REDUCIBLE wire formats (cast
+    compressors — values may be summed at the wire dtype) are legal here:
+    chunked quantizers (fp8/int8) carry per-chunk scales that cannot be
+    summed in flight, so they must go through the exchange-then-reduce
+    fusion pipeline (``fused_allreduce`` / the bucketed reduce-scatter
+    path) — passing one raises instead of silently computing garbage.
     """
     if op not in (Sum, Average, Min, Max, Adasum):
         raise ValueError(f"unknown reduction op: {op!r}")
+    if compression is not None and getattr(compression, "chunked", False):
+        raise ValueError(
+            f"{compression.name} is a chunked quantizer: its per-chunk "
+            "scales cannot be summed on the wire, so a plain allreduce "
+            "cannot carry it. Use hvd.fused_allreduce(...) or the bucketed "
+            "pipeline (DistributedOptimizer(compression=...)), which "
+            "exchange compressed chunks and reduce after decoding.")
     axes = _resolve_axes(axes)
     nbytes = _wire_bytes(x)
-    _tele.record_collective("allreduce", nbytes)
     if not _in_named_context(axes):
+        _tele.record_collective("allreduce", nbytes,
+                                logical_nbytes=logical_nbytes)
         return _eager_recorded("allreduce",
                                lambda: _eager_allreduce(x, op, axes),
                                x, nbytes)
     _flightrec.collective_enter("allreduce", x, nbytes=nbytes, mode="trace")
     if compression is not None:
         x, ctx = compression.compress(x)
+        _tele.record_collective("allreduce", _wire_bytes(x),
+                                logical_nbytes=nbytes)
+    else:
+        # logical_nbytes: a caller (fused_allreduce's cast path) that
+        # narrowed the payload BEFORE this dispatch passes the
+        # uncompressed width so the logical/wire ratio stays honest
+        _tele.record_collective("allreduce", nbytes,
+                                logical_nbytes=logical_nbytes)
     if op == Sum:
         out = lax.psum(x, axes)
     elif op == Average:
@@ -133,17 +155,23 @@ def allreduce(x, op=Average, axes=None, compression=None):
     return out
 
 
-def allgather(x, axes=None, tiled=True):
+def allgather(x, axes=None, tiled=True, logical_nbytes=None):
     """Concatenate ``x`` from all shards along dim 0 (reference:
     ``MPIAllgather`` / ``gloo::allgatherv``, ``mpi_operations.cc``).
 
     XLA collectives are static-shape, so all shards must contribute the same
     shape here; the variable-length (allgatherv) semantics of the reference
     live in the eager path, which pads to the negotiated max length.
+
+    ``logical_nbytes`` overrides the uncompressed-byte accounting when the
+    payload is already at a narrowed wire width (the compressed fusion
+    pipeline passes the logical width of what it narrowed; 0 marks pure
+    wire overhead like quantizer scales).
     """
     axes = _resolve_axes(axes)
     nbytes = _wire_bytes(x)
-    _tele.record_collective("allgather", nbytes)
+    _tele.record_collective("allgather", nbytes,
+                            logical_nbytes=logical_nbytes)
     if not _in_named_context(axes):
         # hash_shape=False: the eager path carries allgatherv semantics
         # (per-rank first dims may differ by design), so the shape must
@@ -182,7 +210,7 @@ def broadcast(x, root_rank=0, axes=None):
     return lax.psum(contrib, axes)
 
 
-def reducescatter(x, op=Sum, axes=None):
+def reducescatter(x, op=Sum, axes=None, logical_nbytes=None):
     """Reduce across shards and scatter the result: each shard gets a
     1/size slice along dim 0. Internal building block in the reference's
     hierarchical path (``nccl_operations.cc:198-248``), exposed here as a
@@ -191,12 +219,14 @@ def reducescatter(x, op=Sum, axes=None):
     Chunk ``i`` of dim 0 lands on the shard whose ``mesh_rank(axes)`` is
     ``i`` — the same linearized ordering every other collective uses, and
     the inverse of :func:`allgather` (``allgather(reducescatter(x))``
-    round-trips when the reduction is a no-op)."""
+    round-trips when the reduction is a no-op). ``logical_nbytes``: see
+    :func:`allgather`."""
     axes = _resolve_axes(axes)
     if op not in (Sum, Average):
         raise ValueError("reducescatter supports Sum or Average")
     nbytes = _wire_bytes(x)
-    _tele.record_collective("reducescatter", nbytes)
+    _tele.record_collective("reducescatter", nbytes,
+                            logical_nbytes=logical_nbytes)
     if not _in_named_context(axes):
         return _eager_recorded("reducescatter",
                                lambda: _eager_reducescatter(x, op, axes),
@@ -211,17 +241,19 @@ def reducescatter(x, op=Sum, axes=None):
     return out
 
 
-def alltoall(x, axes=None):
+def alltoall(x, axes=None, logical_nbytes=None):
     """Split dim 0 into size chunks, exchange chunk i with shard i, concat
     along dim 0. (Not in Horovod 0.18.2 — added for the sequence-parallel /
     Ulysses path; Horovod grew hvd.alltoall later.)
 
     Multiple axes are treated as ONE linearized participant set, major
     axis slowest — chunk i goes to the shard whose ``mesh_rank`` is i,
-    matching every other collective's rank ordering."""
+    matching every other collective's rank ordering. ``logical_nbytes``:
+    see :func:`allgather`."""
     axes = _resolve_axes(axes)
     nbytes = _wire_bytes(x)
-    _tele.record_collective("alltoall", nbytes)
+    _tele.record_collective("alltoall", nbytes,
+                            logical_nbytes=logical_nbytes)
     if not _in_named_context(axes):
         return _eager_recorded("alltoall",
                                lambda: _eager_alltoall(x, axes),

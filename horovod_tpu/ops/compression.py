@@ -1,21 +1,49 @@
 """Gradient compression for the collective wire format.
 
 Mirrors ``horovod/torch/compression.py`` / ``horovod/tensorflow/compression.py``
-(74 LoC each): a ``Compression`` namespace with ``none`` and ``fp16``
-compressors, each exposing ``compress(tensor) -> (tensor, ctx)`` and
-``decompress(tensor, ctx) -> tensor``.
+(74 LoC each) — a ``Compression`` namespace whose members expose
+``compress(tensor) -> (tensor, ctx)`` / ``decompress(tensor, ctx)`` — and
+goes beyond the reference with TPU-native sub-16-bit wire formats.
 
-TPU-first difference: the narrow wire dtype defaults to **bfloat16** (the
-MXU/ICI-native 16-bit format, same exponent range as fp32 so no loss
-scaling needed); ``fp16`` is kept as an alias and an explicit
-``float16`` compressor is available.
+Two families live here, distinguished by whether the wire format survives
+an in-flight reduction:
+
+* **Cast compressors** (``bf16``/``fp16``/``float16``): a plain dtype cast.
+  Sums of cast values are meaningful, so the collective itself can run at
+  the wire dtype (``psum``/``psum_scatter`` in bf16) — the reference
+  ``FP16Compressor`` model. TPU-first default is **bfloat16** (MXU/ICI
+  native, fp32 exponent range, no loss scaling needed).
+
+* **Chunked quantizers** (``fp8_e4m3``/``fp8_e5m2``/``int8``): each chunk
+  of the flat bucket is scaled by its own fp32 scale (absmax mapped onto
+  the wire format's representable range) before narrowing. Quantized
+  values under DIFFERENT scales cannot be summed on the wire, so these
+  carry ``chunked = True`` and the fusion pipeline routes them through
+  exchange-then-locally-reduce collectives (all-to-all for the
+  reduce-scatter half) instead of an in-wire ``psum`` —
+  ``ops/fusion.py``. The per-bucket error-feedback residual that keeps
+  the training trajectory glued to the exact path is computed from
+  :meth:`ChunkedQuantizer.roundtrip` and threaded through the train
+  state by ``training.make_train_step`` (docs/PERFORMANCE.md, "Wire
+  compression").
+
+Non-float leaves (integer/bool gradients — rare, but e.g. embedding hit
+counters ride gradient pytrees) are never narrowed: they pass through at
+their own dtype with ``ctx=None`` and must round-trip **bit-exactly**.
+Telemetry accounts them at their true wire width — the logical-vs-wire
+byte counters in ``ops/collective.py`` only credit compression for bytes
+that were actually narrowed.
 """
 
 import jax.numpy as jnp
+import numpy as np
 
 
 class NoneCompressor:
     """Pass-through (reference ``NoneCompressor``)."""
+
+    name = "none"
+    chunked = False
 
     @staticmethod
     def compress(tensor):
@@ -29,10 +57,14 @@ class NoneCompressor:
 
 class _CastCompressor:
     """Cast floating tensors to a narrow wire dtype for the collective, cast
-    back after (reference ``FP16Compressor``)."""
+    back after (reference ``FP16Compressor``). The wire format is reducible:
+    collectives may sum at the wire dtype."""
 
-    def __init__(self, wire_dtype):
+    chunked = False
+
+    def __init__(self, wire_dtype, name=None):
         self.wire_dtype = wire_dtype
+        self.name = name or str(np.dtype(wire_dtype))
 
     def compress(self, tensor):
         dtype = tensor.dtype
@@ -45,13 +77,217 @@ class _CastCompressor:
             return tensor.astype(ctx)
         return tensor
 
+    # -- bucket-level interface (shared with ChunkedQuantizer) -------------
+    # The fusion pipeline talks to every wire format through
+    # compress_flat/decompress_flat so the cast and quantize families are
+    # interchangeable per bucket; for a cast wire the "scales" slot is None.
+
+    def compress_flat(self, flat):
+        """``flat [..., n] -> (wire [..., n], scales=None)``."""
+        if not jnp.issubdtype(flat.dtype, jnp.floating):
+            return flat, None
+        return flat.astype(self.wire_dtype), None
+
+    def decompress_flat(self, wire, scales, dtype, n=None):
+        del scales
+        out = wire.astype(dtype)
+        if n is not None and out.shape[-1] != n:
+            out = out[..., :n]
+        return out
+
+    def roundtrip(self, flat):
+        """``(wire, scales, dequantized)`` — the dequantized view feeds the
+        error-feedback residual (``flat - dequantized``)."""
+        wire, _ = self.compress_flat(flat)
+        return wire, None, wire.astype(flat.dtype)
+
+    def wire_bytes(self, n_elements, logical_dtype):
+        """Bytes this wire format puts on the interconnect for
+        ``n_elements`` of ``logical_dtype`` (non-float leaves ride
+        uncompressed)."""
+        if not jnp.issubdtype(jnp.dtype(logical_dtype), jnp.floating):
+            return int(n_elements) * np.dtype(logical_dtype).itemsize
+        return int(n_elements) * np.dtype(self.wire_dtype).itemsize
+
+
+# Default elements per fp32 scale. 256 keeps the scale overhead at
+# 4/256 = 1.6% of the logical bytes while bounding every element's
+# distance from its chunk absmax (the quantization step is
+# absmax/range_max PER CHUNK, not per bucket — a single huge gradient
+# spike only coarsens its own 256 neighbours).
+DEFAULT_CHUNK = 256
+
+
+class ChunkedQuantizer:
+    """Narrow wire dtype + one fp32 scale per ``chunk`` elements.
+
+    ``compress_flat(flat [..., n]) -> (wire [..., n_pad], scales [..., c])``
+    chunks along the LAST axis (the flat-bucket axis in the fusion
+    pipeline; leading axes — the ``[world, shard]`` row layout of the
+    reduce-scatter exchange — are preserved, so chunks never straddle a
+    shard boundary and each destination rank can decode its rows from the
+    scales it received). ``n_pad`` rounds ``n`` up to a chunk multiple;
+    ``decompress_flat(..., n=n)`` slices the pad back off.
+
+    The wire is NOT reducible (``chunked = True``): per-chunk scales
+    differ across ranks, so the exchange must decompress before summing.
+    """
+
+    chunked = True
+
+    def __init__(self, wire_dtype, range_max, name, chunk=DEFAULT_CHUNK,
+                 integer=False):
+        self.wire_dtype = wire_dtype
+        self.range_max = float(range_max)
+        self.name = name
+        self.chunk = int(chunk)
+        self.integer = integer
+
+    def __repr__(self):
+        return f"ChunkedQuantizer({self.name}, chunk={self.chunk})"
+
+    def _padded(self, n):
+        return n + (-n) % self.chunk
+
+    def for_length(self, n):
+        """Quantizer with the chunk clamped to a payload of ``n`` elements:
+        a reduce-scatter shard smaller than the configured chunk would
+        otherwise pay chunk-rounding padding on every row of the exchange
+        (a 1-element shard shipping 256 wire bytes). Both ends of a
+        collective derive the clamped quantizer from the same static shard
+        size, so encode and decode always agree."""
+        if n >= self.chunk:
+            return self
+        return ChunkedQuantizer(self.wire_dtype, self.range_max, self.name,
+                                chunk=max(1, int(n)), integer=self.integer)
+
+    def compress_flat(self, flat):
+        wire, scales, _ = self._quantize(flat, want_dequant=False)
+        return wire, scales
+
+    def roundtrip(self, flat):
+        """``(wire, scales, dequantized)`` in one pass — the error-feedback
+        residual is ``flat - dequantized`` and reusing the quantize
+        intermediates keeps it one multiply instead of a second decode."""
+        return self._quantize(flat, want_dequant=True)
+
+    def _quantize(self, flat, want_dequant):
+        if not jnp.issubdtype(flat.dtype, jnp.floating):
+            # non-float leaves are never narrowed — bit-exact passthrough
+            return flat, None, flat
+        n = flat.shape[-1]
+        pad = self._padded(n) - n
+        x = flat.astype(jnp.float32)
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros(x.shape[:-1] + (pad,), jnp.float32)], axis=-1)
+        chunks = x.reshape(x.shape[:-1] + (-1, self.chunk))
+        absmax = jnp.max(jnp.abs(chunks), axis=-1)
+        # a zero chunk keeps scale 1 so 0/scale stays 0 (no NaN lanes)
+        scales = jnp.where(absmax > 0.0, absmax / self.range_max, 1.0)
+        scaled = chunks / scales[..., None]
+        if self.integer:
+            q = jnp.clip(jnp.round(scaled), -self.range_max, self.range_max)
+            wire = q.astype(self.wire_dtype)
+        else:
+            wire = scaled.astype(self.wire_dtype)
+        wire = wire.reshape(x.shape)
+        deq = None
+        if want_dequant:
+            deq = (wire.astype(jnp.float32)
+                   .reshape(chunks.shape) * scales[..., None])
+            deq = deq.reshape(x.shape)[..., :n].astype(flat.dtype)
+        return wire, scales, deq
+
+    def decompress_flat(self, wire, scales, dtype, n=None):
+        """Inverse of :meth:`compress_flat`: ``wire [..., n_pad]`` +
+        ``scales [..., c]`` back to ``[..., n]`` at ``dtype``."""
+        if scales is None:  # non-float passthrough
+            return wire if n is None else wire[..., :n]
+        chunks = wire.astype(jnp.float32).reshape(
+            wire.shape[:-1] + (-1, self.chunk))
+        out = (chunks * scales[..., None]).reshape(wire.shape)
+        if n is not None:
+            out = out[..., :n]
+        return out.astype(dtype)
+
+    def wire_bytes(self, n_elements, logical_dtype):
+        """Interconnect bytes for ``n_elements`` of ``logical_dtype``:
+        padded wire payload + the fp32 scales riding with it (non-float
+        leaves pass through at full width)."""
+        if not jnp.issubdtype(jnp.dtype(logical_dtype), jnp.floating):
+            return int(n_elements) * np.dtype(logical_dtype).itemsize
+        n_pad = self._padded(int(n_elements))
+        n_scales = n_pad // self.chunk
+        return (n_pad * np.dtype(self.wire_dtype).itemsize
+                + n_scales * 4)
+
+    # -- reference-shaped eager interface ---------------------------------
+    # compress/decompress(tensor, ctx) keep the Compression namespace
+    # uniform for user code that round-trips a single tensor outside the
+    # fusion pipeline. ctx carries (scales, dtype, n, shape).
+
+    def compress(self, tensor):
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor, None
+        flat = tensor.reshape(-1)
+        wire, scales = self.compress_flat(flat)
+        return wire, (scales, tensor.dtype, flat.shape[-1],
+                      tensor.shape)
+
+    def decompress(self, tensor, ctx):
+        if ctx is None:
+            return tensor
+        scales, dtype, n, shape = ctx
+        return self.decompress_flat(tensor, scales, dtype, n).reshape(shape)
+
+
+# fp8 representable maxima (finite): e4m3fn tops out at 448, e5m2 at 57344.
+# Scaling each chunk's absmax onto the format maximum spends the full
+# mantissa on every chunk regardless of the gradient's absolute magnitude.
+_E4M3_MAX = 448.0
+_E5M2_MAX = 57344.0
+
 
 class Compression:
-    """Namespace matching the reference API: ``Compression.none``,
+    """Namespace matching the reference API — ``Compression.none``,
     ``Compression.fp16`` (bfloat16 wire on TPU), ``Compression.bf16``,
-    ``Compression.float16`` (true IEEE fp16 wire)."""
+    ``Compression.float16`` (true IEEE fp16 wire) — plus the sub-byte
+    chunked-scale wire formats: ``fp8_e4m3`` (3 mantissa bits — the
+    default fp8 pick), ``fp8_e5m2`` (wider exponent, coarser mantissa),
+    ``int8`` (symmetric per-chunk scale, round-to-nearest). ``fp8`` is
+    an alias for ``fp8_e4m3``."""
 
     none = NoneCompressor()
     bf16 = _CastCompressor(jnp.bfloat16)
     fp16 = bf16  # TPU-native 16-bit wire format
     float16 = _CastCompressor(jnp.float16)
+    fp8_e4m3 = ChunkedQuantizer(jnp.float8_e4m3fn, _E4M3_MAX, "fp8_e4m3")
+    fp8_e5m2 = ChunkedQuantizer(jnp.float8_e5m2, _E5M2_MAX, "fp8_e5m2")
+    fp8 = fp8_e4m3
+    int8 = ChunkedQuantizer(jnp.int8, 127.0, "int8", integer=True)
+
+
+_BY_NAME = {
+    "none": None,
+    "bf16": Compression.bf16,
+    "fp16": Compression.bf16,
+    "float16": Compression.float16,
+    "fp8": Compression.fp8_e4m3,
+    "fp8_e4m3": Compression.fp8_e4m3,
+    "fp8_e5m2": Compression.fp8_e5m2,
+    "int8": Compression.int8,
+}
+
+
+def by_name(name):
+    """Resolve a wire-dtype name (config/autotune/bench surface) to a
+    compressor; ``"none"``/``None`` mean uncompressed."""
+    if name is None:
+        return None
+    try:
+        return _BY_NAME[str(name).lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown wire dtype {name!r}; pick one of "
+            f"{sorted(_BY_NAME)}") from None

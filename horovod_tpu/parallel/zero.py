@@ -184,21 +184,40 @@ def _local_param_rows(schedule, leaves):
     return rows
 
 
-def apply_shards(tx, grad_rows, zstate, params):
+def apply_shards(tx, grad_rows, zstate, params, wire=None,
+                 ag_residuals=None):
     """The sharded-update tail: run ``tx.update`` on this rank's gradient
     shards (``{"bi": [1, shard]}``), then all-gather the updated-parameter
     DELTAS back into a full update pytree. Must run inside a named-axis
     context (shard_map). Returns ``(updates, new_zstate)`` with ``updates``
-    shaped like ``params`` — feed ``optax.apply_updates``."""
+    shaped like ``params`` — feed ``optax.apply_updates``.
+
+    ``wire`` (an ``ops.compression`` compressor) narrows the delta
+    all-gather to the wire format; ``ag_residuals`` (a list of per-bucket
+    shard-sized arrays) additionally turns on delta error feedback — the
+    quantization error of THIS rank's delta shard is carried into the
+    next step's shard before encoding, so the cumulative applied delta
+    tracks the exact one (DoubleSqueeze-style; ``training.
+    make_train_step`` threads the residuals through the train state).
+    With ``ag_residuals`` the return grows to ``(updates, new_zstate,
+    new_ag_residuals)``."""
     schedule = zstate.plan.schedule
     leaves, treedef = jax.tree_util.tree_flatten(params)
     param_rows = _local_param_rows(schedule, leaves)
     update_rows, new_inner = tx.update(grad_rows, zstate.inner, param_rows)
 
+    new_residuals = list(ag_residuals) if ag_residuals is not None else None
     new_leaves = [None] * len(leaves)
     for i in range(len(schedule.buckets)):
-        flat = fusion.all_gather_bucket(schedule, i,
-                                        update_rows[_bucket_key(i)][0])
+        row = update_rows[_bucket_key(i)][0]
+        if wire is None:
+            flat = fusion.all_gather_bucket(schedule, i, row)
+        else:
+            res = ag_residuals[i] if ag_residuals is not None else None
+            flat, new_res = fusion.all_gather_bucket_compressed(
+                schedule, i, row, wire, residual=res)
+            if new_residuals is not None:
+                new_residuals[i] = new_res
         for j, arr in fusion.unpack_bucket(schedule, i, flat,
                                            leaves).items():
             new_leaves[j] = arr
@@ -209,25 +228,37 @@ def apply_shards(tx, grad_rows, zstate, params):
         raise ValueError(
             f"ZeRO plan does not cover gradient leaves {missing}; was the "
             "optimizer initialized with a different parameter tree?")
-    return jax.tree_util.tree_unflatten(treedef, new_leaves), \
-        ZeroState(new_inner, zstate.plan)
+    updates = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    new_zstate = ZeroState(new_inner, zstate.plan)
+    if new_residuals is not None:
+        return updates, new_zstate, new_residuals
+    return updates, new_zstate
 
 
-def sharded_update(tx, grads, zstate, params):
+def sharded_update(tx, grads, zstate, params, wire=None):
     """Full ZeRO-1 exchange for one already-accumulated gradient pytree:
     per-bucket reduce-scatter → sharded ``tx.update`` → all-gather of the
     updates. The ``DistributedOptimizer(sharded_update=True).update``
     implementation; the overlapped microbatch pipeline in
     ``training.make_train_step`` instead accumulates reduce-scattered
-    shards itself and calls :func:`apply_shards` directly."""
+    shards itself and calls :func:`apply_shards` directly.
+
+    ``wire`` compresses both halves of the exchange (gradient
+    reduce-scatter + delta all-gather) STATELESSLY — this entry point has
+    no step-to-step carry, so no error feedback; the pipeline path in
+    ``make_train_step`` is the one that threads residuals."""
     schedule = zstate.plan.schedule
     leaves = jax.tree_util.tree_leaves(grads)
     grad_rows = {}
     for i in range(len(schedule.buckets)):
-        shard = fusion.reduce_scatter_bucket(schedule, i, leaves,
-                                             op=zstate.plan.op)
+        if wire is None:
+            shard = fusion.reduce_scatter_bucket(schedule, i, leaves,
+                                                 op=zstate.plan.op)
+        else:
+            shard, _ = fusion.reduce_scatter_bucket_compressed(
+                schedule, i, leaves, wire, op=zstate.plan.op)
         grad_rows[_bucket_key(i)] = shard[None]
-    return apply_shards(tx, grad_rows, zstate, params)
+    return apply_shards(tx, grad_rows, zstate, params, wire=wire)
 
 
 def local_state_bytes(zstate):

@@ -35,8 +35,13 @@ COMPILE_SECONDS = "horovod_compile_seconds_total"
 # -- collectives / fusion ---------------------------------------------------
 COLLECTIVE_CALLS = "horovod_collective_calls_total"
 COLLECTIVE_BYTES = "horovod_collective_bytes_total"
+COLLECTIVE_LOGICAL_BYTES = "horovod_collective_logical_bytes_total"
 BUCKET_FILL_RATIO = "horovod_bucket_fill_ratio"
 BUCKET_DISPATCH_SECONDS = "horovod_bucket_dispatch_seconds"
+# -- wire compression (ops/compression.py + the fusion pipeline) ------------
+WIRE_BYTES = "hvd_wire_bytes_total"
+WIRE_LOGICAL_BYTES = "hvd_wire_logical_bytes_total"
+WIRE_COMPRESSION_RATIO = "hvd_wire_compression_ratio"
 # -- elastic ----------------------------------------------------------------
 RENDEZVOUS_EPOCHS = "horovod_rendezvous_epochs_total"
 BLACKLIST_HOSTS = "horovod_blacklist_hosts"
@@ -166,10 +171,73 @@ def _bytes_child(op_name):
     child = _child_cache.get(("bytes", op_name))
     if child is None:
         child = get_registry().counter(
-            COLLECTIVE_BYTES, "Wire bytes moved by collective dispatches",
+            COLLECTIVE_BYTES, "Wire bytes moved by collective dispatches "
+            "(COMPRESSED width when a wire format is active)",
             label_names=("op",)).labels(op_name)
         _child_cache[("bytes", op_name)] = child
     return child
+
+
+def _logical_bytes_child(op_name):
+    child = _child_cache.get(("logical", op_name))
+    if child is None:
+        child = get_registry().counter(
+            COLLECTIVE_LOGICAL_BYTES,
+            "Uncompressed (logical) bytes behind each collective dispatch; "
+            "equals " + COLLECTIVE_BYTES + " when no wire compression is "
+            "active — the per-op compression ratio is logical/wire",
+            label_names=("op",)).labels(op_name)
+        _child_cache[("logical", op_name)] = child
+    return child
+
+
+def _wire_dtype_children(dtype_name):
+    pair = _child_cache.get(("wire_dtype", dtype_name))
+    if pair is None:
+        r = get_registry()
+        pair = (
+            r.counter(WIRE_BYTES,
+                      "Bytes actually put on the interconnect per LOGICAL "
+                      "payload dtype (wire payload + quantizer scales; "
+                      "non-float leaves ride at full width)",
+                      label_names=("dtype",)).labels(dtype_name),
+            r.counter(WIRE_LOGICAL_BYTES,
+                      "Uncompressed bytes of the same payloads, per "
+                      "logical dtype",
+                      label_names=("dtype",)).labels(dtype_name),
+        )
+        _child_cache[("wire_dtype", dtype_name)] = pair
+    return pair
+
+
+_ratio_gauge_installed = False
+
+
+def _ensure_ratio_gauge():
+    """``hvd_wire_compression_ratio``: cumulative logical/wire byte ratio
+    across every collective dispatch (1.0 = nothing compressed). Derived
+    at collect time from the two counter families so it can never drift
+    from them."""
+    global _ratio_gauge_installed
+    if _ratio_gauge_installed:
+        return
+    r = get_registry()
+
+    def _total(fam):
+        if fam is None:
+            return 0.0
+        s = fam.sample()
+        return sum(s.values()) if isinstance(s, dict) else float(s)
+
+    def ratio():
+        w = _total(r.get(COLLECTIVE_BYTES))
+        lg = _total(r.get(COLLECTIVE_LOGICAL_BYTES))
+        return (lg / w) if w > 0 else 1.0
+
+    r.gauge(WIRE_COMPRESSION_RATIO,
+            "Cumulative logical/wire byte ratio over all collective "
+            "dispatches (1.0 = uncompressed)").set_function(ratio)
+    _ratio_gauge_installed = True
 
 
 def _bucket_children(kind):
@@ -189,21 +257,44 @@ def _bucket_children(kind):
     return pair
 
 
-def record_collective(op_name, nbytes):
+def record_collective(op_name, nbytes, logical_nbytes=None):
     """Per-op call count + wire bytes. Called from the collective
     dispatch functions, i.e. at TRACE time on the compiled path (the
     counts describe the collectives baked into each compiled program)
     and per call on the eager path — docs/OBSERVABILITY.md explains how
-    to read the two."""
+    to read the two.
+
+    ``nbytes`` is what actually crosses the interconnect (COMPRESSED
+    width when a wire format is active); ``logical_nbytes`` is the
+    uncompressed payload behind it (defaults to ``nbytes``) — the
+    compression ratio is derivable from the two counters, and
+    ``hvd_wire_compression_ratio`` pre-derives the cumulative one."""
     _calls_child(op_name).inc()
     _bytes_child(op_name).inc(max(0, int(nbytes)))
+    _logical_bytes_child(op_name).inc(
+        max(0, int(nbytes if logical_nbytes is None else logical_nbytes)))
+    _ensure_ratio_gauge()
 
 
-def record_bucket(kind, fill_ratio, nbytes, dispatch_s=None):
-    """Bucketed reduce-scatter/all-gather pipeline instrumentation."""
+def record_bucket(kind, fill_ratio, nbytes, dispatch_s=None,
+                  logical_nbytes=None, dtype=None):
+    """Bucketed reduce-scatter/all-gather pipeline instrumentation.
+    ``nbytes`` is wire width, ``logical_nbytes`` uncompressed width, and
+    ``dtype`` the bucket's LOGICAL dtype — feeding the per-dtype
+    logical-vs-wire accounting (non-float buckets are never narrowed, so
+    their two counters advance in lockstep)."""
     fill, dispatch = _bucket_children(kind)
     fill.observe(fill_ratio)
-    _bytes_child(f"bucket_{kind}").inc(max(0, int(nbytes)))
+    wire = max(0, int(nbytes))
+    logical = max(0, int(nbytes if logical_nbytes is None
+                         else logical_nbytes))
+    _bytes_child(f"bucket_{kind}").inc(wire)
+    _logical_bytes_child(f"bucket_{kind}").inc(logical)
+    if dtype is not None:
+        w_child, l_child = _wire_dtype_children(str(dtype))
+        w_child.inc(wire)
+        l_child.inc(logical)
+    _ensure_ratio_gauge()
     if dispatch_s is not None:
         dispatch.observe(dispatch_s)
 

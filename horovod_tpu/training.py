@@ -112,7 +112,8 @@ def _placer(mesh, spec):
 
 def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                     batch_axes=None, donate=True, dropout_seed=0,
-                    accum_steps=1, overlap_grads=False, telemetry=None):
+                    accum_steps=1, overlap_grads=False, telemetry=None,
+                    error_feedback=True):
     """Build a jitted SPMD classification train step.
 
     Returns ``step(state, inputs, labels) -> (state, loss)`` where
@@ -151,6 +152,30 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     the overlapped paths; root-mean of per-shard local norms otherwise —
     docs/OBSERVABILITY.md); when off the program is byte-identical to
     the uninstrumented build.
+
+    **Wire compression** (``DistributedOptimizer(compression=...)``) in
+    the ``overlap_grads`` pipeline narrows every bucket collective to the
+    wire format. The format is resolved when THIS function is called and
+    baked into the compiled program — build the step after the autotuner
+    installs ``config.wire_dtype`` (a later config change warns at the
+    next step call instead of silently applying). The reduce-scatter
+    ships quantized gradient rows, and
+    the all-gather (of gradient shards, or of ZeRO-1's parameter deltas)
+    ships quantized shards — 1/4 the wire bytes at fp8/int8, 1/2 at
+    bf16. With ``error_feedback=True`` (default) one fp32 residual per
+    bucket AND direction is threaded through the step: each step's
+    quantization error is added back into the next step's bucket before
+    encoding, which is what keeps the compressed trajectory within the
+    documented epsilon of the exact one (docs/PERFORMANCE.md, "Wire
+    compression"). The residual buffers live OUTSIDE the checkpointable
+    ``TrainState`` — they are rebuildable state, initialized to zero and
+    excluded from checkpoint manifests; a restore merely restarts the
+    compensation (``step.reset_error_feedback()`` drops the carry
+    explicitly after rolling ``state`` back to an earlier commit, and a
+    step that raises drops it automatically — the donated buffers may
+    already be invalid). With ``tx.compression is None`` the residual plumbing
+    vanishes and the compiled program is byte-identical to the
+    uncompressed build.
     """
     from horovod_tpu import hvd_jax
     from horovod_tpu import telemetry as telemetry_lib
@@ -176,12 +201,48 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
             raise ValueError(
                 "accum_steps and backward_passes_per_step are two "
                 "accumulators for the same thing; use accum_steps")
-    if overlap_grads and tx.compression is not None:
-        raise ValueError("overlap_grads does not compose with wire "
-                         "compression yet")
     sharded_tx = is_hvd_tx and tx.sharded_update
     reduce_axes = (tuple(tx.axes) if is_hvd_tx and tx.axes is not None
                    else data_axes)
+    # wire compression rides the bucket collectives of the OVERLAP
+    # pipeline here; the non-overlapped paths compress inside tx's own
+    # fused allreduce / sharded_update. Error feedback needs a
+    # step-to-step carry, so it exists only when a wire format is on.
+    # The wire format is resolved HERE, once: it is baked into the
+    # compiled program (bucket collectives, residual shapes), so build
+    # the step AFTER the autotuner installs its wire-axis winner. A
+    # config change after build cannot take effect — _check_wire_drift
+    # warns instead of silently diverging from tx.compression.
+    wire = tx.compression if (is_hvd_tx and overlap_grads) else None
+    use_ef = wire is not None and error_feedback
+
+    def _grad_schedule(params, world):
+        """The ONE bucket-schedule recipe for this step's gradient
+        exchange — local_step (world from the named axes) and the EF
+        residual allocation (world from the step's mesh) must shape
+        against the same plan."""
+        return fusion.bucket_schedule(
+            jax.tree_util.tree_leaves(params), world=world,
+            threshold_bytes=tx.threshold_bytes, axes=reduce_axes,
+            hierarchical=tx._hierarchical_resolved())
+
+    _wire_drift_warned = [False]
+
+    def _check_wire_drift():
+        if not is_hvd_tx or not overlap_grads or _wire_drift_warned[0]:
+            return
+        now = tx.compression
+        if now is not wire:
+            _wire_drift_warned[0] = True
+            import warnings
+            warnings.warn(
+                f"tx.compression resolves to "
+                f"{getattr(now, 'name', None)!r} but this train step was "
+                f"built with {getattr(wire, 'name', None)!r} — the wire "
+                "format is baked into the compiled program at "
+                "make_train_step time. Rebuild the step (after the "
+                "autotuner / config install) for the new format to take "
+                "effect.", stacklevel=3)
 
     def micro_grads(state, stats, inputs, labels, dropout_rng):
         """Loss + grads of one microbatch at fixed params."""
@@ -199,7 +260,13 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
 
         return jax.value_and_grad(compute_loss, has_aux=True)(state.params)
 
-    def local_step(state, inputs, labels):
+    def local_step(state, wire_state, inputs, labels):
+        # wire_state: {"rs": [per-bucket residual], "ag": [...]} — empty
+        # (no leaves, so no effect on the compiled program) unless error
+        # feedback is on. Each residual arrives as this shard's [1, n]
+        # row of the [world, n] global buffer; squeeze for the bucket ops.
+        rs_res = [r[0] for r in wire_state.get("rs", ())]
+        ag_res = [r[0] for r in wire_state.get("ag", ())]
         # per-step AND per-shard dropout stream (reference semantics:
         # each rank draws independent masks); each microbatch folds its
         # index in on top
@@ -217,11 +284,8 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
             # the optimizer-state partition IS the bucket schedule
             schedule = state.opt_state.plan.schedule
         elif overlap_grads:
-            schedule = fusion.bucket_schedule(
-                jax.tree_util.tree_leaves(state.params),
-                world=collective.mesh_size(reduce_axes),
-                threshold_bytes=tx.threshold_bytes, axes=reduce_axes,
-                hierarchical=tx._hierarchical_resolved())
+            schedule = _grad_schedule(state.params,
+                                      collective.mesh_size(reduce_axes))
         else:
             schedule = None
 
@@ -242,12 +306,22 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                     # linear — summing per-microbatch shards equals
                     # scattering the sum)
                     leaves_k = jax.tree_util.tree_leaves(grads_k)
-                    shards_k = [
-                        fusion.reduce_scatter_bucket(
-                            schedule, i, leaves_k,
-                            op=state.opt_state.plan.op if sharded_tx
-                            else tx.op)
-                        for i in range(len(schedule.buckets))]
+                    rs_op = (state.opt_state.plan.op if sharded_tx
+                             else tx.op)
+                    shards_k = []
+                    for i in range(len(schedule.buckets)):
+                        if wire is None:
+                            s = fusion.reduce_scatter_bucket(
+                                schedule, i, leaves_k, op=rs_op)
+                        else:
+                            s, new_r = \
+                                fusion.reduce_scatter_bucket_compressed(
+                                    schedule, i, leaves_k, wire, op=rs_op,
+                                    residual=(rs_res[i] if use_ef
+                                              else None))
+                            if use_ef:
+                                rs_res[i] = new_r
+                        shards_k.append(s)
                     acc_shards = (shards_k if acc_shards is None else
                                   [a + s for a, s in zip(acc_shards,
                                                          shards_k)])
@@ -273,13 +347,29 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                     local_sq, op=collective.Sum, axes=reduce_axes))
             if sharded_tx:
                 grad_rows = {f"b{i}": s[None] for i, s in enumerate(shards)}
-                updates, opt_state = zero_lib.apply_shards(
-                    tx.inner, grad_rows, state.opt_state, state.params)
+                if wire is None:
+                    updates, opt_state = zero_lib.apply_shards(
+                        tx.inner, grad_rows, state.opt_state, state.params)
+                elif use_ef:
+                    updates, opt_state, ag_res = zero_lib.apply_shards(
+                        tx.inner, grad_rows, state.opt_state, state.params,
+                        wire=wire, ag_residuals=ag_res)
+                else:
+                    updates, opt_state = zero_lib.apply_shards(
+                        tx.inner, grad_rows, state.opt_state, state.params,
+                        wire=wire)
             else:
                 leaves, treedef = jax.tree_util.tree_flatten(state.params)
                 new_leaves = [None] * len(leaves)
                 for i, s in enumerate(shards):
-                    flat = fusion.all_gather_bucket(schedule, i, s)
+                    if wire is None:
+                        flat = fusion.all_gather_bucket(schedule, i, s)
+                    else:
+                        flat, new_r = fusion.all_gather_bucket_compressed(
+                            schedule, i, s, wire,
+                            residual=ag_res[i] if use_ef else None)
+                        if use_ef:
+                            ag_res[i] = new_r
                     for j, arr in fusion.unpack_bucket(
                             schedule, i, flat, leaves).items():
                         new_leaves[j] = arr
@@ -312,25 +402,84 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                                     op=collective.Average, axes=data_axes)
         new_state = TrainState(params=params, opt_state=opt_state,
                                batch_stats=stats, step=state.step + 1)
+        new_wire = {"rs": [r[None] for r in rs_res],
+                    "ag": [r[None] for r in ag_res]}
         if tele_on:
-            return new_state, loss, gnorm
-        return new_state, loss
+            return new_state, new_wire, loss, gnorm
+        return new_state, new_wire, loss
 
-    def outer(state, inputs, labels):
+    wire_spec = P(tuple(reduce_axes))
+
+    def outer(state, wire_state, inputs, labels):
         specs = state_specs(state)
-        out_specs = (specs, P(), P()) if tele_on else (specs, P())
+        wspecs = jax.tree_util.tree_map(lambda _: wire_spec, wire_state)
+        out_specs = ((specs, wspecs, P(), P()) if tele_on
+                     else (specs, wspecs, P()))
         sharded = jax.shard_map(
             local_step, mesh=mesh,
-            in_specs=(specs, P(data_axes), P(data_axes)),
+            in_specs=(specs, wspecs, P(data_axes), P(data_axes)),
             out_specs=out_specs,
             check_vma=False)
-        return sharded(state, inputs, labels)
+        return sharded(state, wire_state, inputs, labels)
 
-    jitted = jax.jit(outer, donate_argnums=(0,) if donate else ())
+    # wire_state is an EMPTY pytree unless error feedback is on, so the
+    # extra jit argument contributes zero buffers and the compiled
+    # program stays byte-identical to the uncompressed build.
+    jitted = jax.jit(outer, donate_argnums=(0, 1) if donate else ())
     place_data = _placer(mesh, P(data_axes))
 
     def place_state(state):
         return _placer(mesh, state_specs(state))(state)
+
+    _wire_holder = [None]
+
+    def _wire_state_for(state):
+        """Zero-initialized per-bucket residual buffers ([world, n] global,
+        row r = rank r's carry), rebuilt lazily from the live state —
+        rebuildable by construction, so never checkpointed."""
+        if not use_ef:
+            return {"rs": [], "ag": []}
+        if sharded_tx:
+            schedule = state.opt_state.plan.schedule
+        else:
+            # world from the mesh THIS step was built on (the global
+            # mesh can be a different one — e.g. a sub-mesh step built
+            # while a bigger mesh is set — and a mismatched world here
+            # would shape the residual buffers against the wrong
+            # schedule)
+            mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+            schedule = _grad_schedule(
+                state.params,
+                int(np.prod([mesh_shape[a] for a in reduce_axes])))
+        w = schedule.world
+
+        def size_or_zero(i, n):
+            # non-float buckets are never quantized (the bucket ops pass
+            # their residual through untouched) — a zero-width buffer
+            # keeps the per-bucket index alignment without the HBM or
+            # donation traffic of a dead fp32 carry
+            return n if jnp.issubdtype(schedule.buckets[i].dtype,
+                                       jnp.floating) else 0
+
+        ws = {"rs": [jnp.zeros((w, size_or_zero(i, p)), jnp.float32)
+                     for i, p in enumerate(schedule.padded_sizes)],
+              "ag": [jnp.zeros((w, size_or_zero(i, s)), jnp.float32)
+                     for i, s in enumerate(schedule.shard_sizes)]}
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, jax.sharding.NamedSharding(mesh, wire_spec)), ws)
+
+    def _wire_state(state):
+        if _wire_holder[0] is None:
+            _wire_holder[0] = _wire_state_for(state)
+        return _wire_holder[0]
+
+    def _reset_error_feedback():
+        """Drop the carried residuals; the next step rebuilds zeros.
+        Call after restoring ``state`` to an earlier commit (elastic
+        rollback / checkpoint restore) so the compensation restarts
+        clean instead of carrying a later step's error."""
+        _wire_holder[0] = None
 
     from horovod_tpu.diag import recorder as _flightrec
 
@@ -344,11 +493,22 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
             # stays byte-identical either way, tests/test_diag.py)
             n = _step_no[0]
             _step_no[0] = n + 1
+            _check_wire_drift()
             _flightrec.step_begin(n)
-            out = jitted(place_state(state), place_data(inputs),
-                         place_data(labels))
+            try:
+                new_state, new_wire, loss = jitted(
+                    place_state(state), _wire_state(state),
+                    place_data(inputs), place_data(labels))
+                _wire_holder[0] = new_wire
+            except BaseException:
+                # the residuals were donated into the failed dispatch and
+                # may already be invalidated — drop them so the retry
+                # path (elastic rollback) rebuilds zeros instead of
+                # dying on deleted arrays forever
+                _wire_holder[0] = None
+                raise
             _flightrec.step_end(n)
-            return out
+            return new_state, loss
     else:
         from horovod_tpu import basics as _basics
         import time as _time
@@ -358,6 +518,7 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
 
         def step(state, inputs, labels):
             step_no = int(instruments.steps.value)
+            _check_wire_drift()
             _flightrec.step_begin(step_no)
             tl = _basics._state.timeline
             flow = None
@@ -372,9 +533,13 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                 tl._step_flow_id = flow
             t0 = _time.perf_counter()
             try:
-                new_state, loss, gnorm = jitted(
-                    place_state(state), place_data(inputs),
-                    place_data(labels))
+                new_state, new_wire, loss, gnorm = jitted(
+                    place_state(state), _wire_state(state),
+                    place_data(inputs), place_data(labels))
+                _wire_holder[0] = new_wire
+            except BaseException:
+                _wire_holder[0] = None  # donated into the failed dispatch
+                raise
             finally:
                 if flow is not None:
                     first_trace[0] = False
@@ -392,13 +557,14 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
         step.instruments = instruments
 
     step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
+    step.reset_error_feedback = _reset_error_feedback
 
     def lower(state, inputs, labels):
         """AOT lower with the SAME placement the executed path uses, so
         the compile cache is shared and cost_analysis describes the
         module that actually runs."""
-        return jitted.lower(place_state(state), place_data(inputs),
-                            place_data(labels))
+        return jitted.lower(place_state(state), _wire_state(state),
+                            place_data(inputs), place_data(labels))
 
     step.lower = lower
     return step

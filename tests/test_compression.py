@@ -1,0 +1,805 @@
+"""Wire-compressed collectives (ISSUE 6): chunked fp8/int8 quantizers,
+the compressed bucketed reduce-scatter/all-gather pipeline, per-bucket
+error feedback, the autotuner's wire-dtype axis, and the logical-vs-wire
+telemetry accounting.
+
+The load-bearing contracts pinned here:
+
+* chunked quantizers round-trip within their format's error bound, pad
+  chunk-indivisible buckets correctly, and pass non-float leaves through
+  **bit-exactly**;
+* the compressed reduce-scatter's all-to-all exchange preserves shard
+  ownership (rank-varying inputs reduce to the same shards as the exact
+  path);
+* the two stale guards are gone — ``overlap_grads`` + compression and
+  ``sharded_update`` + compression compose — while genuinely unsupported
+  combos (chunked wire + Adasum/Min/Max, chunked wire in a plain
+  ``allreduce``) raise loudly;
+* error feedback is **load-bearing**: on a 30-step quadratic bowl whose
+  gradient absmax is dominated by one outlier coordinate, int8+EF lands
+  on the fp32 oracle's parameters while int8 without EF measurably does
+  not;
+* with compression off, the compiled train step is byte-identical to a
+  build with the residual plumbing compiled out.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import horovod_tpu as hvd_api  # noqa: E402
+from horovod_tpu import training  # noqa: E402
+from horovod_tpu.models.simple import MLP  # noqa: E402
+from horovod_tpu.ops import collective, fusion  # noqa: E402
+from horovod_tpu.ops import compression as clib  # noqa: E402
+from horovod_tpu.parallel import mesh as mesh_lib  # noqa: E402
+
+Compression = clib.Compression
+
+# Per-format round-trip error bound, as a fraction of the chunk absmax:
+# bf16 has 8 mantissa bits (2^-8 relative), fp16 11, e4m3 3 bits of
+# mantissa (2^-3 relative at the top of the scaled range), e5m2 2 bits,
+# int8 one part in 254 of absmax (round-to-nearest over [-127, 127]).
+ERR_BOUND = {
+    "bf16": 1 / 256,
+    "float16": 1 / 2048,
+    "fp8_e4m3": 1 / 8,
+    "fp8_e5m2": 1 / 4,
+    "int8": 1 / 250,
+}
+
+
+# ---------------------------------------------------------------------------
+# quantizer unit tests
+
+
+@pytest.mark.parametrize("name", sorted(ERR_BOUND))
+def test_roundtrip_within_format_bound(name):
+    c = clib.by_name(name)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(1000),
+                    jnp.float32)
+    wire, ctx = c.compress(x)
+    back = c.decompress(wire, ctx)
+    assert back.shape == x.shape and back.dtype == x.dtype
+    err = float(jnp.max(jnp.abs(back - x)))
+    absmax = float(jnp.max(jnp.abs(x)))
+    assert err <= absmax * ERR_BOUND[name], (name, err, absmax)
+
+
+def test_chunk_size_does_not_divide_bucket():
+    """Bucket-boundary case (satellite): n=1000 against chunk=256 pads to
+    1024 on the wire; decompress slices the pad back off and the payload
+    survives within the int8 bound."""
+    q = Compression.int8
+    assert q.chunk == clib.DEFAULT_CHUNK == 256
+    x = jnp.asarray(np.random.default_rng(1).standard_normal(1000),
+                    jnp.float32)
+    wire, scales = q.compress_flat(x)
+    assert wire.shape == (1024,) and wire.dtype == jnp.int8
+    assert scales.shape == (4,) and scales.dtype == jnp.float32
+    back = q.decompress_flat(wire, scales, jnp.float32, n=1000)
+    assert back.shape == (1000,)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               atol=float(jnp.max(jnp.abs(x))) / 250)
+    # wire_bytes accounts the pad AND the scales that ride along
+    assert q.wire_bytes(1000, jnp.float32) == 1024 * 1 + 4 * 4
+
+
+def test_for_length_clamps_chunk_to_shard():
+    """A reduce-scatter shard smaller than the configured chunk must not
+    ship chunk-rounding padding: for_length clamps, and both ends derive
+    the same clamped quantizer from the same static shard size."""
+    q = Compression.int8
+    small = q.for_length(5)
+    assert small.chunk == 5 and small.wire_dtype == q.wire_dtype
+    assert q.for_length(1000) is q  # no clamp needed
+    x = jnp.asarray([1.0, -2.0, 3.0, -4.0, 5.0], jnp.float32)
+    wire, scales = small.compress_flat(x)
+    assert wire.shape == (5,) and scales.shape == (1,)
+    back = small.decompress_flat(wire, scales, jnp.float32, n=5)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=0.03)
+
+
+def test_multi_row_compress_preserves_leading_axes():
+    """The fusion pipeline quantizes [world, shard] rows; chunks must
+    never straddle the row (= shard ownership) boundary."""
+    q = Compression.fp8_e4m3
+    rows = jnp.asarray(
+        np.random.default_rng(2).standard_normal((4, 300)), jnp.float32)
+    qq = q.for_length(300)
+    wire, scales = qq.compress_flat(rows)
+    assert wire.shape[0] == 4 and scales.shape[0] == 4
+    back = qq.decompress_flat(wire, scales, jnp.float32, n=300)
+    assert back.shape == (4, 300)
+    for r in range(4):
+        absmax = float(jnp.max(jnp.abs(rows[r])))
+        assert float(jnp.max(jnp.abs(back[r] - rows[r]))) <= absmax / 8
+
+
+@pytest.mark.parametrize("name", ["bf16", "int8", "fp8_e4m3"])
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.int8, jnp.bool_])
+def test_nonfloat_leaves_roundtrip_bit_exact(name, dtype):
+    """Integer/bool gradients are never narrowed (satellite): they pass
+    through both compressor interfaces bit-exactly at their own dtype,
+    and wire_bytes accounts them at FULL width — no phantom compression
+    ratio for payloads that were not compressed."""
+    c = clib.by_name(name)
+    x = jnp.asarray(np.asarray([0, 1, 1, 0, 1, 0, 0, 1] * 4), dtype)
+    wire, ctx = c.compress(x)
+    assert wire.dtype == x.dtype
+    back = c.decompress(wire, ctx)
+    assert back.dtype == x.dtype
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+    wire_f, scales = c.compress_flat(x)
+    assert wire_f.dtype == x.dtype and scales is None
+    np.testing.assert_array_equal(
+        np.asarray(c.decompress_flat(wire_f, None, x.dtype, n=x.shape[-1])),
+        np.asarray(x))
+    # full-width accounting for the uncompressed leaf
+    assert c.wire_bytes(32, dtype) == 32 * np.dtype(dtype).itemsize
+
+
+def test_wire_bytes_accounting_float():
+    assert Compression.bf16.wire_bytes(100, jnp.float32) == 200
+    assert Compression.float16.wire_bytes(100, jnp.float32) == 200
+    # 100 elems pad to 256 (one chunk) + one fp32 scale
+    assert Compression.int8.wire_bytes(100, jnp.float32) == 256 + 4
+    assert Compression.fp8_e4m3.wire_bytes(100, jnp.float32) == 256 + 4
+
+
+def test_by_name_resolution():
+    assert clib.by_name(None) is None
+    assert clib.by_name("none") is None
+    assert clib.by_name("fp16") is Compression.bf16  # TPU-native alias
+    assert clib.by_name("fp8") is Compression.fp8_e4m3
+    with pytest.raises(ValueError, match="unknown wire dtype"):
+        clib.by_name("fp4")
+
+
+# ---------------------------------------------------------------------------
+# collective/pipeline composition
+
+
+def test_plain_allreduce_rejects_chunked_wire(hvd):
+    """A chunked quantizer's per-chunk scales cannot be summed in flight:
+    the plain allreduce must refuse instead of computing garbage."""
+    with pytest.raises(ValueError, match="chunked"):
+        collective.allreduce(jnp.ones(8), compression=Compression.int8)
+
+
+def test_chunked_wire_rejects_nonlinear_reductions(hvd):
+    tree = {"a": jnp.ones(64)}
+    spec = {"a": P()}
+
+    def f(t):
+        return fusion.fused_allreduce(t, op=hvd_api.Min, compression="int8")
+
+    g = jax.shard_map(f, mesh=hvd.mesh(), in_specs=(spec,), out_specs=spec,
+                      check_vma=False)
+    with pytest.raises(ValueError, match="Sum/Average"):
+        g(tree)
+
+
+def test_distributed_optimizer_adasum_rejects_chunked():
+    with pytest.raises(ValueError, match="Adasum"):
+        hvd_api.DistributedOptimizer(optax.sgd(0.1), op=hvd_api.Adasum,
+                                     compression="int8")
+    # cast wire (reducible) stays legal with Adasum
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.1), op=hvd_api.Adasum,
+                                      compression="bf16")
+    assert tx.compression is Compression.bf16
+
+
+def test_fused_allreduce_mixed_pytree_all_formats(hvd):
+    """Satellite: mixed-dtype pytrees through the compressed fused
+    allreduce — float leaves within the wire format's bound, non-float
+    leaves BIT-exact."""
+    rng = np.random.default_rng(5)
+    tree = {
+        "w": jnp.asarray(rng.standard_normal(257), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((3, 7)), jnp.float32),
+        "counts": jnp.asarray(rng.integers(0, 100, 13), jnp.int32),
+    }
+    spec = jax.tree_util.tree_map(lambda _: P(), tree)
+    world = len(jax.devices())
+
+    def run(wire):
+        f = jax.shard_map(
+            lambda t: fusion.fused_allreduce(t, op=hvd_api.Sum,
+                                             compression=wire),
+            mesh=hvd.mesh(), in_specs=(spec,), out_specs=spec,
+            check_vma=False)
+        return f(tree)
+
+    exact = run(None)
+    for name in ("bf16", "fp8_e4m3", "int8"):
+        got = run(name)
+        for key in ("w", "b"):
+            assert got[key].dtype == tree[key].dtype
+            absmax = float(jnp.max(jnp.abs(exact[key])))
+            err = float(jnp.max(jnp.abs(got[key] - exact[key])))
+            assert err <= absmax * ERR_BOUND[name] * 2, (name, key, err)
+        np.testing.assert_array_equal(np.asarray(got["counts"]),
+                                      np.asarray(exact["counts"]))
+        np.testing.assert_array_equal(np.asarray(got["counts"]),
+                                      world * np.asarray(tree["counts"]))
+
+
+def test_compressed_reduce_scatter_shard_ownership(hvd):
+    """Rank-VARYING inputs: the compressed path's all-to-all must deliver
+    rank r's quantized contribution of MY shard to me, in mesh-rank
+    order — the same ownership contract as reducescatter. A scrambled
+    exchange produces garbage far outside the quantization bound."""
+    world = len(jax.devices())
+    n = 64
+
+    def body(_):
+        r = collective.mesh_rank()
+        # distinct, rank-dependent payload
+        leaf = (jnp.arange(n, dtype=jnp.float32) + 100.0 * r) / 10.0
+        leaves = [leaf]
+        schedule = fusion.bucket_schedule(leaves, world=world)
+        exact = fusion.reduce_scatter_bucket(schedule, 0, leaves,
+                                             op=collective.Average)
+        comp, _res = fusion.reduce_scatter_bucket_compressed(
+            schedule, 0, leaves, Compression.int8, op=collective.Average)
+        return exact, comp
+
+    f = jax.shard_map(body, mesh=hvd.mesh(), in_specs=(P(),),
+                      out_specs=(P("data"), P("data")), check_vma=False)
+    exact, comp = f(jnp.zeros(world))
+    # int8 bound: per-rank error <= chunk_absmax/254, averaged over world
+    atol = (100.0 * world / 10.0) / 250
+    np.testing.assert_allclose(np.asarray(comp), np.asarray(exact),
+                               atol=atol)
+
+
+def test_overlap_pipeline_guards_lifted(hvd):
+    """The two stale refusals are gone: overlap_grads + compression and
+    sharded_update + compression now build AND run."""
+    model = MLP(features=(10, 3))
+    X = jnp.asarray(np.random.default_rng(0).standard_normal((16, 5)),
+                    jnp.float32)
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 3, 16), jnp.int32)
+    for sharded in (False, True):
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.05),
+                                          sharded_update=sharded,
+                                          compression="int8")
+        state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                            X[:1])
+        step = training.make_train_step(model, tx, accum_steps=2,
+                                        overlap_grads=True, donate=False)
+        for _ in range(2):
+            state, loss = step(state, X, y)
+            assert np.isfinite(float(loss))
+
+
+def test_config_wire_dtype_is_the_default(hvd):
+    """DistributedOptimizer(compression=None) defers to config.wire_dtype
+    (the autotuner's wire-axis install target); an explicit "none" forces
+    uncompressed regardless of config."""
+    from horovod_tpu import basics
+    cfg = basics._state.config
+    old = cfg.wire_dtype
+    try:
+        cfg.wire_dtype = "fp8_e5m2"
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.1))
+        assert tx.compression is Compression.fp8_e5m2
+        tx_off = hvd_api.DistributedOptimizer(optax.sgd(0.1),
+                                              compression="none")
+        assert tx_off.compression is None
+    finally:
+        cfg.wire_dtype = old
+
+
+# ---------------------------------------------------------------------------
+# error feedback
+
+
+def _bowl_mesh(n_ranks=2):
+    devices = jax.devices()[:n_ranks]
+    mesh = mesh_lib.build_mesh(devices=devices, num_slices=1)
+    mesh_lib.set_mesh(mesh)
+    return mesh, mesh_lib.data_axis_names(mesh), len(devices)
+
+
+def test_error_feedback_is_load_bearing_quadratic_bowl():
+    """Satellite: 30-step quadratic bowl on CPU. The design matrix is
+    orthogonal (per-coordinate curvature 2 — a perfectly conditioned
+    bowl) and the true optimum has one outlier coordinate at 300, so the
+    early gradient absmax is dominated by that coordinate and every
+    small-gradient chunk-mate quantizes to ZERO at int8. Without error
+    feedback those coordinates receive no update while the outlier
+    dominates, and the trajectory deviation they accumulate has a
+    component in the problem's one flat direction (bias vs kernel) that
+    never decays — the final parameters land measurably off the fp32
+    oracle. WITH error feedback the residual carries the rounded-away
+    gradients into later steps, and the final parameters land on the
+    oracle to ~1e-5: the residual is load-bearing, not decorative."""
+    mesh, axes, n = _bowl_mesh(2)
+    D = 32
+    rng = np.random.default_rng(3)
+    Q, _ = np.linalg.qr(rng.standard_normal((D, D)))
+    shard_X = Q * np.sqrt(D)  # X^T X = D*I
+    w_true = np.ones(D)
+    w_true[0] = 300.0
+    shard_y = shard_X @ w_true
+    X = jnp.asarray(np.tile(shard_X, (n, 1)), jnp.float32)
+    y = jnp.asarray(np.tile(shard_y, n), jnp.float32)
+    model = MLP(features=(1,))
+
+    def mse(logits, labels):
+        return jnp.mean((logits[:, 0] - labels) ** 2)
+
+    def run(wire, ef):
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.4), axes=axes,
+                                          compression=wire)
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0), X[:1])
+        step = training.make_train_step(model, tx, mesh=mesh, loss_fn=mse,
+                                        donate=False, overlap_grads=True,
+                                        error_feedback=ef)
+        for _ in range(30):
+            state, loss = step(state, X, y)
+        return float(loss), state.params
+
+    loss_exact, p_exact = run("none", True)
+    loss_ef, p_ef = run("int8", True)
+    loss_noef, p_noef = run("int8", False)
+    assert loss_exact < 1e-6  # the bowl is solvable and solved
+
+    def gap(p):
+        return max(float(jnp.max(jnp.abs(a - b))) for a, b in
+                   zip(jax.tree_util.tree_leaves(p),
+                       jax.tree_util.tree_leaves(p_exact)))
+
+    g_ef, g_noef = gap(p_ef), gap(p_noef)
+    # int8+EF lands on the oracle; int8 without EF measurably does not
+    # (two orders of magnitude of separation, asserted with margin both
+    # ways so neither platform noise nor a broken residual can slip by)
+    assert g_ef < 3e-3, f"EF failed to land on the oracle: gap {g_ef}"
+    assert g_noef > 3e-2, (
+        f"no-EF landed on the oracle (gap {g_noef}) — the bowl no longer "
+        "exercises the stall, or EF leaked into the ef=False build")
+    assert g_noef > 10 * g_ef
+
+
+def test_ef_residual_changes_compiled_program_only_when_compressed(hvd):
+    """With compression OFF the residual plumbing must vanish: the
+    lowered step with error_feedback=True is byte-identical to one with
+    it disabled (acceptance: no regression to the uncompressed path)."""
+    model = MLP(features=(8, 3))
+    X = jnp.zeros((16, 4), jnp.float32)
+    y = jnp.zeros((16,), jnp.int32)
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.1), compression="none")
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        X[:1])
+    texts = []
+    for ef in (True, False):
+        step = training.make_train_step(model, tx, donate=False,
+                                        overlap_grads=True,
+                                        error_feedback=ef)
+        texts.append(step.lower(state, X, y).as_text())
+    assert texts[0] == texts[1]
+    # ...and the same build WITH a wire format is a different program —
+    # the off-vs-off identity above is structural (wire=None makes
+    # error_feedback select the same build), so this is the direction
+    # that catches compression silently not being applied
+    tx_on = hvd_api.DistributedOptimizer(optax.sgd(0.1),
+                                         compression="int8")
+    state_on = training.create_train_state(model, tx_on,
+                                           jax.random.PRNGKey(0), X[:1])
+    step_on = training.make_train_step(model, tx_on, donate=False,
+                                       overlap_grads=True)
+    assert step_on.lower(state_on, X, y).as_text() != texts[0]
+
+
+# ---------------------------------------------------------------------------
+# autotune wire axis
+
+
+def test_autotune_joint_wire_axis(hvd):
+    """wire_candidates turns the search grid into the (threshold, wire)
+    cross product, reusing the abstain machinery; apply installs BOTH
+    config.fusion_threshold and config.wire_dtype."""
+    from horovod_tpu import basics
+    tree = {"a": jnp.ones((512,)), "b": jnp.ones((64, 8))}
+    candidates = [1 << 10, 1 << 20]
+    wires = ["none", "int8"]
+    best, timings = fusion.autotune_fusion_threshold(
+        tree, candidates=candidates, trials=2, wire_candidates=wires)
+    assert set(timings) == {(t, w) for t in candidates for w in wires}
+    assert all(float(v) > 0 for v in timings.values())
+    if best is None:
+        assert timings.abstain_reason
+        return
+    thr, wire = best
+    assert thr in candidates and wire in wires
+    assert basics._state.config.fusion_threshold == thr
+    assert basics._state.config.wire_dtype == (None if wire == "none"
+                                               else wire)
+
+
+def test_autotune_wire_axis_rejects_typo():
+    with pytest.raises(ValueError, match="unknown wire dtype"):
+        fusion.autotune_fusion_threshold(
+            {"a": jnp.ones(8)}, candidates=[1 << 20], trials=1,
+            wire_candidates=["int9"])
+
+
+# ---------------------------------------------------------------------------
+# telemetry accounting
+
+
+def test_record_collective_logical_vs_wire_bytes():
+    from horovod_tpu import telemetry
+    from horovod_tpu.telemetry import instruments
+    reg = telemetry.get_registry()
+
+    def total(name, op):
+        fam = reg.get(name)
+        if fam is None:
+            return 0.0
+        s = fam.sample()
+        return float(s.get((op,), 0.0)) if isinstance(s, dict) else float(s)
+
+    w0 = total(instruments.COLLECTIVE_BYTES, "testop")
+    l0 = total(instruments.COLLECTIVE_LOGICAL_BYTES, "testop")
+    instruments.record_collective("testop", 512, logical_nbytes=2048)
+    assert total(instruments.COLLECTIVE_BYTES, "testop") - w0 == 512
+    assert total(instruments.COLLECTIVE_LOGICAL_BYTES, "testop") - l0 == 2048
+    # without logical_nbytes the two families advance in lockstep
+    instruments.record_collective("testop", 100)
+    assert total(instruments.COLLECTIVE_BYTES, "testop") - w0 == 612
+    assert total(instruments.COLLECTIVE_LOGICAL_BYTES, "testop") - l0 == 2148
+    # the ratio gauge is derived from the same counters at collect time
+    fam = reg.get(instruments.WIRE_COMPRESSION_RATIO)
+    assert fam is not None
+    assert float(fam.sample()) >= 1.0
+
+
+def test_record_bucket_per_dtype_wire_accounting():
+    from horovod_tpu import telemetry
+    from horovod_tpu.telemetry import instruments
+    reg = telemetry.get_registry()
+
+    def total(name, dtype):
+        fam = reg.get(name)
+        if fam is None:
+            return 0.0
+        s = fam.sample()
+        return float(s.get((dtype,), 0.0)) if isinstance(s, dict) \
+            else float(s)
+
+    key = "float32"
+    w0 = total(instruments.WIRE_BYTES, key)
+    l0 = total(instruments.WIRE_LOGICAL_BYTES, key)
+    instruments.record_bucket("rs", 1.0, 260, logical_nbytes=1024,
+                              dtype=jnp.dtype(jnp.float32))
+    assert total(instruments.WIRE_BYTES, key) - w0 == 260
+    assert total(instruments.WIRE_LOGICAL_BYTES, key) - l0 == 1024
+
+
+def test_compressed_pipeline_reports_compressed_bytes(hvd):
+    """End to end: a compressed fused allreduce advances the wire-bytes
+    counter by LESS than the logical-bytes counter (the per-op
+    compression ratio is derivable from /metrics)."""
+    from horovod_tpu import telemetry
+    from horovod_tpu.telemetry import instruments
+    reg = telemetry.get_registry()
+
+    def totals():
+        out = []
+        for name in (instruments.COLLECTIVE_BYTES,
+                     instruments.COLLECTIVE_LOGICAL_BYTES):
+            fam = reg.get(name)
+            s = fam.sample() if fam is not None else {}
+            out.append(sum(s.values()) if isinstance(s, dict)
+                       else float(s or 0.0))
+        return out
+
+    tree = {"w": jnp.ones(4096, jnp.float32)}
+    spec = {"w": P()}
+    w0, l0 = totals()
+    f = jax.shard_map(
+        lambda t: fusion.fused_allreduce(t, op=hvd_api.Sum,
+                                         compression="int8"),
+        mesh=hvd.mesh(), in_specs=(spec,), out_specs=spec, check_vma=False)
+    f(tree)
+    w1, l1 = totals()
+    assert l1 - l0 > 0
+    # int8 wire: ~1/4 the logical fp32 bytes (plus scales). The bound is
+    # over the CUMULATIVE families — the bucket aggregates and the inner
+    # alltoall/allgather dispatches they wrap must agree on what was
+    # narrowed (the inner collectives record their logical width too;
+    # scales ride as logical-0 overhead), or the ratio degrades toward 2.
+    ratio = (l1 - l0) / (w1 - w0)
+    assert ratio > 3.0, f"cumulative logical/wire ratio {ratio:.2f}"
+
+
+def test_chunked_rs_wire_bytes_counts_per_row_padding(hvd):
+    """The chunked reduce-scatter's wire-byte record must price what the
+    alltoall actually ships: EACH of the world [shard]-rows pads to a
+    chunk multiple and carries its own scales — pricing one flat-bucket
+    encode undercounts whenever chunk does not divide the shard."""
+    from horovod_tpu import telemetry
+    from horovod_tpu.telemetry import instruments
+    mesh, axes, world = _bowl_mesh(2)
+    reg = telemetry.get_registry()
+
+    def total():
+        fam = reg.get(instruments.COLLECTIVE_BYTES)
+        s = fam.sample() if fam is not None else {}
+        return float(s.get(("bucket_rs",), 0.0))
+
+    leaves = [jnp.zeros(600, jnp.float32)]  # shard=300: 256 !| 300
+    schedule = fusion.bucket_schedule(leaves, world=world,
+                                      threshold_bytes=1 << 30, axes=axes)
+    q = Compression.int8
+
+    def body(x):
+        shard, _ = fusion.reduce_scatter_bucket_compressed(
+            schedule, 0, [x], q, op=hvd_api.Sum)
+        return shard
+
+    b0 = total()
+    jax.shard_map(body, mesh=mesh, in_specs=(P(),),
+                  out_specs=P(axes), check_vma=False)(leaves[0])
+    # per row: padded(300)=512 int8 bytes + 2 fp32 scales, x world rows
+    assert total() - b0 == (512 + 2 * 4) * world
+
+
+def test_config_wire_dtype_binds_late(hvd):
+    """The config deferral resolves at ACCESS time, not construction: an
+    optimizer built before the autotuner installs its wire-axis winner
+    (or before hvd.init() populates the config) still picks it up."""
+    from horovod_tpu import basics
+    cfg = basics._state.config
+    old = cfg.wire_dtype
+    try:
+        cfg.wire_dtype = None
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.1))
+        assert tx.compression is None
+        cfg.wire_dtype = "int8"          # autotune installs after build
+        assert tx.compression is Compression.int8
+        cfg.wire_dtype = None
+        assert tx.compression is None
+        # an explicit "none" given at construction stays pinned off
+        tx_off = hvd_api.DistributedOptimizer(optax.sgd(0.1),
+                                              compression="none")
+        cfg.wire_dtype = "fp8_e4m3"
+        assert tx_off.compression is None
+        # the non-sharded chained transform must not freeze a stale
+        # resolution at init(): install-after-init rebuilds the chain
+        # with the new wire (regression: init() -> autotune installs ->
+        # update() trained uncompressed while tx.compression lied)
+        cfg.wire_dtype = None
+        tx2 = hvd_api.DistributedOptimizer(optax.sgd(0.1))
+        tx2.init({"w": jnp.ones(4)})
+        assert tx2._transform_wire is None
+        cfg.wire_dtype = "int8"
+        tx2._ensure_transform()
+        assert tx2._transform_wire is Compression.int8
+    finally:
+        cfg.wire_dtype = old
+
+
+def test_step_failure_does_not_brick_error_feedback(hvd):
+    """The EF residuals are donated into each dispatch; a step call that
+    raises must drop the carried buffers so the NEXT call (the elastic
+    retry path) rebuilds zeros instead of dying on deleted arrays, and
+    reset_error_feedback() gives rollbacks an explicit restart."""
+    model = MLP(features=(10, 3))
+    X = jnp.asarray(np.random.default_rng(0).standard_normal((16, 5)),
+                    jnp.float32)
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 3, 16), jnp.int32)
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.05), sharded_update=True,
+                                      compression="int8")
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        X[:1])
+    step = training.make_train_step(model, tx, accum_steps=2,
+                                    overlap_grads=True)  # donate=True
+    state, _ = step(state, X, y)  # populates + donates the residuals
+    with pytest.raises(Exception):
+        step(state, X[:, :3], y)  # wrong feature width — dispatch fails
+    state, loss = step(state, X, y)  # must NOT raise "Array has been deleted"
+    assert np.isfinite(float(loss))
+    step.reset_error_feedback()
+    state, loss = step(state, X, y)
+    assert np.isfinite(float(loss))
+
+
+def test_overlap_step_warns_on_wire_drift(hvd):
+    """The overlapped step bakes the wire format at build time; a config
+    install AFTER the build cannot apply — the step must warn at the
+    next call instead of silently training at the stale format while
+    tx.compression reports the new one."""
+    from horovod_tpu import basics
+    cfg = basics._state.config
+    old = cfg.wire_dtype
+    try:
+        cfg.wire_dtype = None
+        model = MLP(features=(8, 3))
+        X = jnp.zeros((16, 4), jnp.float32)
+        y = jnp.zeros((16,), jnp.int32)
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.1))
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0), X[:1])
+        step = training.make_train_step(model, tx, donate=False,
+                                        overlap_grads=True)
+        state, _ = step(state, X, y)  # no drift yet: no warning
+        cfg.wire_dtype = "int8"       # autotune installs after build
+        with pytest.warns(UserWarning, match="baked into the compiled"):
+            step(state, X, y)
+    finally:
+        cfg.wire_dtype = old
+
+
+def test_error_feedback_residual_stays_fp32_for_bf16_grads(hvd):
+    """The EF carry must not be truncated to the gradient dtype: for
+    bf16 gradients the int8 quantization error sits at or below the
+    bf16 ulp, so compensation done AT bf16 would round away entirely."""
+    mesh, axes, world = _bowl_mesh(2)
+    vals = np.linspace(0.5, 1.0, 512, dtype=np.float32)
+    leaves = [jnp.asarray(vals, jnp.bfloat16)]
+    schedule = fusion.bucket_schedule(leaves, world=world,
+                                      threshold_bytes=1 << 30, axes=axes)
+    shard = schedule.shard_sizes[0]
+    res0 = jnp.zeros((schedule.padded_sizes[0],), jnp.float32)
+
+    def body(x, r):
+        out, new_r = fusion.reduce_scatter_bucket_compressed(
+            schedule, 0, [x], Compression.int8, op=hvd_api.Sum,
+            residual=r)
+        return out, new_r
+
+    out, new_r = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P()),
+        out_specs=(P(axes), P()), check_vma=False)(leaves[0], res0)
+    assert out.dtype == jnp.bfloat16          # output stays at grad dtype
+    assert new_r.dtype == jnp.float32         # carry stays fp32
+    # replicate the pipeline's fp32 math: the carry must be the EXACT
+    # fp32 quantization error of the bf16-representable inputs, not a
+    # bf16-rounded version of it (which would be ~all zeros here)
+    rows32 = np.asarray(leaves[0], np.float32).reshape(world, shard)
+    q = Compression.int8.for_length(shard)
+    _, _, deq = q.roundtrip(jnp.asarray(rows32))
+    expected = rows32 - np.asarray(deq, np.float32)
+    got = np.asarray(new_r, np.float32).reshape(world, shard)
+    np.testing.assert_array_equal(got, expected)
+    assert np.abs(expected).max() > 0  # the signal exists to be kept
+
+
+def test_ef_residuals_follow_the_step_mesh_not_the_global(hvd):
+    """The residual buffers must be shaped against the mesh the step was
+    BUILT on: a sub-mesh step built while a bigger global mesh is set
+    would otherwise allocate [global_world, n] buffers against a
+    [sub_world]-sharded schedule."""
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs a sub-mesh smaller than the global mesh")
+    sub = mesh_lib.build_mesh(devices=devs[:2], num_slices=1)
+    axes = mesh_lib.data_axis_names(sub)
+    model = MLP(features=(8, 3))
+    X = jnp.zeros((8, 4), jnp.float32)
+    y = jnp.zeros((8,), jnp.int32)
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.1), axes=axes,
+                                      compression="int8")
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        X[:1])
+    # global mesh (all devices) stays set; the step gets the sub-mesh
+    step = training.make_train_step(model, tx, mesh=sub, donate=False,
+                                    overlap_grads=True)
+    state, loss = step(state, X, y)
+    assert np.isfinite(float(loss))
+
+
+def test_autotune_eager_fallback_abstains_on_chunked_only(monkeypatch):
+    """The eager (no-mesh) fallback cannot time chunked quantizers; an
+    all-chunked wire grid must warn + abstain instead of dying mid-trial
+    on 'needs the compiled mesh path'."""
+    from horovod_tpu import _core
+    from horovod_tpu.parallel import mesh as pmesh
+
+    def no_mesh():
+        raise RuntimeError("no mesh")
+
+    monkeypatch.setattr(pmesh, "get_mesh", no_mesh)
+    monkeypatch.setattr(_core, "is_initialized", lambda: True)
+    monkeypatch.setattr(_core, "size", lambda: 2)
+    tree = {"w": jnp.ones(64, jnp.float32)}
+    with pytest.warns(UserWarning, match="dropping chunked"):
+        best, timings = fusion.autotune_fusion_threshold(
+            tree, candidates=[1 << 20], apply=False,
+            wire_candidates=["int8", "fp8_e4m3"])
+    assert best is None
+    assert "chunked" in timings.abstain_reason
+
+
+def test_config_wire_incompatible_with_op_is_ignored_with_warning(hvd):
+    """A config-INSTALLED default wire that cannot ride this optimizer's
+    op must be ignored (warned), not retroactively brick training; only
+    an explicit argument hard-errors."""
+    from horovod_tpu import basics
+    cfg = basics._state.config
+    old = cfg.wire_dtype
+    try:
+        cfg.wire_dtype = "int8"
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.1),
+                                          op=hvd_api.Adasum)
+        with pytest.warns(UserWarning, match="ignoring config.wire_dtype"):
+            assert tx.compression is None
+        assert tx.compression is None  # warned once, stays ignored
+    finally:
+        cfg.wire_dtype = old
+
+
+def test_hierarchical_cast_dispatch_keeps_logical_attribution(hvd2d):
+    """The hierarchical branch composes raw lax collectives that record
+    nothing; the dispatch-level record must keep a cast-compressed
+    payload's wire-vs-logical split."""
+    from horovod_tpu import telemetry
+    from horovod_tpu.telemetry import instruments
+    reg = telemetry.get_registry()
+
+    def totals():
+        out = []
+        for name in (instruments.COLLECTIVE_BYTES,
+                     instruments.COLLECTIVE_LOGICAL_BYTES):
+            fam = reg.get(name)
+            s = fam.sample() if fam is not None else {}
+            out.append(float(s.get(("hier_allreduce",), 0.0)))
+        return out
+
+    tree = {"w": jnp.ones(512, jnp.float32)}
+    spec = {"w": P()}
+    w0, l0 = totals()
+    jax.shard_map(
+        lambda t: fusion.fused_allreduce(t, op=hvd_api.Sum,
+                                         compression="bf16",
+                                         hierarchical=True),
+        mesh=hvd2d.mesh(), in_specs=(spec,), out_specs=spec,
+        check_vma=False)(tree)
+    w1, l1 = totals()
+    assert l1 - l0 == 512 * 4          # logical fp32 width
+    assert w1 - w0 == 512 * 2          # bf16 on the wire
+
+
+def test_hierarchical_ignored_for_chunked_wire_warns(hvd2d):
+    """fused_allreduce(hierarchical=True) with a chunked wire on a
+    dcn-bearing mesh warns that the two-level reduction is dropped
+    instead of silently eating the knob."""
+    tree = {"w": jnp.ones(512, jnp.float32)}
+    spec = {"w": P()}
+
+    def body(t):
+        return fusion.fused_allreduce(t, op=hvd_api.Sum,
+                                      compression="int8",
+                                      hierarchical=True)
+
+    f = jax.shard_map(body, mesh=hvd2d.mesh(), in_specs=(spec,),
+                      out_specs=spec, check_vma=False)
+    with pytest.warns(UserWarning, match="hierarchical"):
+        f(tree)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: the dryrun's compressed parity section
+
+
+@pytest.mark.slow
+def test_dryrun_compressed_parity_section():
+    """Satellite (bench/CI): the dryrun oracle-parity harness's wire-
+    compression section — int8+EF and fp8+EF trajectories within the
+    documented epsilon of the exact fp32 path, byte-identical compiled
+    program with compression off — passes on the CPU image."""
+    import __graft_entry__ as graft
+    graft._dryrun_wire_compression(jax.devices()[:2])

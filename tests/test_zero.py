@@ -168,9 +168,16 @@ def test_zero_plan_validates():
 
 
 def test_distributed_optimizer_sharded_rejects_bad_combos():
-    with pytest.raises(ValueError, match="compression"):
-        hvd_api.DistributedOptimizer(optax.sgd(0.1), sharded_update=True,
-                                     compression=hvd_api.Compression.fp16)
+    # the PR-6 contract: sharded_update COMPOSES with wire compression
+    # (the old blanket refusal is gone) ...
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.1), sharded_update=True,
+                                      compression=hvd_api.Compression.fp16)
+    assert tx.compression is hvd_api.Compression.bf16
+    # ... but genuinely unsupported combos stay loud: a chunked quantizer
+    # cannot ride Adasum's dot-product composition
+    with pytest.raises(ValueError, match="Adasum"):
+        hvd_api.DistributedOptimizer(optax.sgd(0.1), op=hvd_api.Adasum,
+                                     compression=hvd_api.Compression.int8)
     with pytest.raises(ValueError, match="backward_passes_per_step"):
         hvd_api.DistributedOptimizer(optax.sgd(0.1), sharded_update=True,
                                      backward_passes_per_step=2)
