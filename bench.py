@@ -169,18 +169,51 @@ def _record_step_time(args, step, state, images, labels, result, suffix):
         result[f"upper_bound_windows_{suffix}"] = n_bound
 
 
+def overlap_variants(compression=None):
+    """The ``--overlap`` comparison matrix: the three exchange variants,
+    extended with ``overlap_rs_zero1_<fmt>`` (the FULL pipeline —
+    overlapped exchange + ZeRO-1 + compressed wire) for each requested
+    wire format. Formats are validated here so a typo dies before any
+    compile. One function so the CLI contract and its test cannot
+    drift."""
+    from horovod_tpu.ops import compression as compression_lib
+
+    variants = {
+        "baseline_fused_ar": dict(sharded=False, overlap=False),
+        "overlap_rs": dict(sharded=False, overlap=True),
+        "overlap_rs_zero1": dict(sharded=True, overlap=True),
+    }
+    wire_formats = []
+    if compression is not None:
+        wire_formats = [f for f in (list(compression)
+                                    or ["bf16", "fp8", "int8"])
+                        if f != "none"]
+        for f in wire_formats:
+            compression_lib.by_name(f)  # fail fast on a typo
+        for fmt in wire_formats:
+            variants[f"overlap_rs_zero1_{fmt}"] = dict(
+                sharded=True, overlap=True, wire=fmt)
+    return variants, wire_formats
+
+
 def overlap_comparison(args):
     """``--overlap``: step time for {baseline fused-allreduce, overlapped
     reduce-scatter pipeline, overlapped + ZeRO-1 sharded update} on the
     same comm-heavy workload (same model, same global batch, same
-    accum_steps), plus measured per-device optimizer-state bytes. One
-    JSON line, same contract as the headline bench."""
-    import numpy as np
+    accum_steps), plus measured per-device optimizer-state bytes.
+    Combined with ``--compression`` the matrix extends with the FULL
+    pipeline — overlapped + ZeRO-1 at each requested wire format
+    (``overlap_rs_zero1_<fmt>``) — so prefetch-era rounds can benchmark
+    the whole exchange (overlap + compressed wire) in one run instead of
+    two mutually-exclusive modes. One JSON line, same contract as the
+    headline bench."""
     import optax
 
     import horovod_tpu as hvd
     from horovod_tpu import training
     from horovod_tpu.utils.benchmarks import make_model, synthetic_batch
+
+    variants, wire_formats = overlap_variants(args.compression)
 
     hvd.init()
     ndev = hvd.num_devices()
@@ -188,20 +221,18 @@ def overlap_comparison(args):
     global_batch = args.batch_size * ndev
     images, labels = synthetic_batch(global_batch, args.image_size)
 
-    variants = {
-        "baseline_fused_ar": dict(sharded=False, overlap=False),
-        "overlap_rs": dict(sharded=False, overlap=True),
-        "overlap_rs_zero1": dict(sharded=True, overlap=True),
-    }
     result = {"metric": f"{args.model}_overlap_pipeline_step_ms",
               "unit": "ms/step", "accum_steps": K, "devices": ndev,
               "per_chip_batch": args.batch_size, "repeats": args.repeats}
+    if wire_formats:
+        result["wire_formats"] = wire_formats
     for name, kind in variants.items():
         # adamw: momentum + second moment = the optimizer state ZeRO-1
         # shards; a fresh model+tx per variant so donation can't alias
         model = make_model(args.model)
         tx = hvd.DistributedOptimizer(optax.adamw(1e-3),
-                                      sharded_update=kind["sharded"])
+                                      sharded_update=kind["sharded"],
+                                      compression=kind.get("wire"))
         step = training.make_train_step(model, tx, donate=True,
                                         accum_steps=K,
                                         overlap_grads=kind["overlap"])
@@ -219,8 +250,9 @@ def overlap_comparison(args):
     if base and z1:
         result["zero1_opt_state_shrink_factor"] = round(base / z1, 2)
     if result.get("step_ms_baseline_fused_ar", 0):
-        for name in ("overlap_rs", "overlap_rs_zero1"):
-            if result.get(f"step_ms_{name}"):
+        for name in variants:
+            if name != "baseline_fused_ar" and \
+                    result.get(f"step_ms_{name}"):
                 result[f"speedup_{name}_vs_baseline"] = round(
                     result["step_ms_baseline_fused_ar"] /
                     result[f"step_ms_{name}"], 3)
@@ -306,6 +338,133 @@ def compression_comparison(args):
     print(json.dumps(result))
 
 
+def data_plane_comparison(args):
+    """``--data-plane``: the INPUT-BOUND configuration. The same compiled
+    train step is driven two ways over the same deterministic batch
+    stream: synchronously (batch assembly + the injected storage latency
+    run on the TRAINING thread, the pre-data-plane behavior) and through
+    the ``PrefetchLoader`` (assembly + host→device staging on the
+    producer thread, overlapped with the running step). Reports both
+    step times, the prefetch speedup, and the data-wait fraction the
+    loader actually charged the training thread
+    (``hvd_data_wait_seconds`` / wall) — when the pipeline keeps up the
+    fraction is ~0 and prefetch-on step time collapses to compute
+    (docs/DATA.md). ``--data-delay-ms`` is the per-batch synthetic
+    storage latency that makes the run input-bound on purpose. One JSON
+    line, same contract as the headline bench."""
+    import time as _time
+
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import telemetry, training
+    from horovod_tpu.data import ArraySource, PrefetchLoader, segment
+    from horovod_tpu.telemetry import instruments as ti
+    from horovod_tpu.utils.benchmarks import (compute_dtype, make_model,
+                                              sync)
+
+    hvd.init()
+    ndev = hvd.num_devices()
+    global_batch = args.batch_size * ndev
+    delay_s = args.data_delay_ms / 1e3
+    iters, warmup = args.num_iters, args.num_warmup
+    seed = 0
+
+    # a host dataset 4 global batches deep, cycled across epochs — the
+    # injected latency, not the resident size, is what models storage
+    rng = np.random.default_rng(seed)
+    n = global_batch * 4
+    images_np = rng.standard_normal(
+        (n, args.image_size, args.image_size, 3)).astype(compute_dtype())
+    labels_np = rng.integers(0, 1000, size=(n,)).astype(np.int32)
+
+    def batch_indices():
+        """The loader's own deterministic plan, reproduced inline — the
+        synchronous baseline consumes the IDENTICAL batch stream."""
+        epoch = 0
+        while True:
+            seg = segment(n, seed=seed, epoch=epoch, world=1,
+                          batch_size=global_batch, shuffle=True)
+            for b in range(len(seg) // global_batch):
+                yield seg[b * global_batch:(b + 1) * global_batch]
+            epoch += 1
+
+    def build():
+        model = make_model(args.model)
+        tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+        step_kw = dict(donate=True)
+        return model, tx, step_kw
+
+    result = {"metric": f"{args.model}_data_plane_step_ms",
+              "unit": "ms/step", "devices": ndev,
+              "per_chip_batch": args.batch_size,
+              "data_delay_ms": args.data_delay_ms,
+              "prefetch_depth": args.prefetch_depth,
+              "timed_iters": iters}
+
+    # -- prefetch OFF: the loader's work serializes with the step -------
+    model, tx, step_kw = build()
+    step = training.make_train_step(model, tx, **step_kw)
+    src = ArraySource([images_np, labels_np], delay_s=delay_s)
+    plan = batch_indices()
+    state = training.create_train_state(
+        model, tx, jax.random.PRNGKey(0), jnp_first(images_np))
+    for _ in range(warmup):
+        x, y = src.batch(next(plan))
+        state, loss = step(state, x, y)
+        sync(loss)
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        x, y = src.batch(next(plan))
+        state, loss = step(state, x, y)
+        sync(loss)
+    off_s = _time.perf_counter() - t0
+    result["step_ms_prefetch_off"] = round(1000 * off_s / iters, 2)
+
+    # -- prefetch ON: producer thread assembles + stages ahead ----------
+    model, tx, step_kw = build()
+    loader = PrefetchLoader(
+        ArraySource([images_np, labels_np], delay_s=delay_s),
+        global_batch, depth=args.prefetch_depth, rank=0, world=1,
+        seed=seed, shuffle=True, drop_last=True)
+    step = training.make_train_step(model, tx, loader=loader, **step_kw)
+    state = training.create_train_state(
+        model, tx, jax.random.PRNGKey(0), jnp_first(images_np))
+    reg = telemetry.get_registry()
+
+    def wait_sum():
+        fam = reg.get(ti.DATA_WAIT_SECONDS)
+        return float(fam.sum) if fam is not None else 0.0
+
+    for _ in range(warmup):
+        state, loss = step(state)
+        sync(loss)
+    w0 = wait_sum()
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state)
+        sync(loss)
+    on_s = _time.perf_counter() - t0
+    waited = wait_sum() - w0
+    loader.close()
+    result["step_ms_prefetch_on"] = round(1000 * on_s / iters, 2)
+    result["data_wait_fraction"] = round(waited / on_s, 4) if on_s else 0.0
+    if on_s > 0:
+        result["prefetch_speedup"] = round(off_s / on_s, 3)
+    fam = reg.get(ti.DATA_BYTES_STAGED)
+    if fam is not None:
+        result["bytes_staged_total"] = int(fam.value)
+    result["telemetry"] = _telemetry_block()
+    print(json.dumps(result))
+
+
+def jnp_first(images_np):
+    """First example as the model-init sample input."""
+    import jax.numpy as jnp
+    return jnp.asarray(images_np[:1])
+
+
 def _telemetry_block():
     """The registry snapshot for the BENCH json: collective bytes and
     bucket fill ride alongside throughput, so perf rounds can attribute
@@ -313,7 +472,7 @@ def _telemetry_block():
     from horovod_tpu import telemetry
     snap = telemetry.get_registry().snapshot()
     keep = ("horovod_collective", "horovod_bucket", "horovod_step",
-            "horovod_examples", "horovod_compile", "hvd_wire")
+            "horovod_examples", "horovod_compile", "hvd_wire", "hvd_data")
     return {k: v for k, v in sorted(snap.items())
             if k.startswith(keep)}
 
@@ -409,7 +568,9 @@ def main():
     parser.add_argument("--overlap", action="store_true",
                         help="run ONLY the overlapped-exchange comparison: "
                              "baseline fused-AR vs bucketed RS pipeline vs "
-                             "RS pipeline + ZeRO-1 (docs/PERFORMANCE.md)")
+                             "RS pipeline + ZeRO-1 (docs/PERFORMANCE.md); "
+                             "add --compression to extend the matrix with "
+                             "compressed-wire overlap+ZeRO-1 variants")
     parser.add_argument("--accum-steps", type=int, default=4,
                         help="gradient-accumulation microbatches for "
                              "--overlap (the pipeline overlaps bucket k's "
@@ -417,22 +578,43 @@ def main():
                              "backward)")
     parser.add_argument("--compression", nargs="*", default=None,
                         metavar="{none,bf16,fp8,int8}",
-                        help="run ONLY the wire-compression comparison: "
-                             "the overlapped pipeline at each listed wire "
+                        help="run the wire-compression comparison: the "
+                             "overlapped pipeline at each listed wire "
                              "format (bare --compression = all four), "
                              "emitting step time, bytes-on-wire, and the "
-                             "compression ratio (docs/PERFORMANCE.md)")
+                             "compression ratio (docs/PERFORMANCE.md). "
+                             "Combined with --overlap it extends that "
+                             "matrix with overlap+ZeRO-1 variants at "
+                             "each wire format — the full pipeline in "
+                             "one run")
+    parser.add_argument("--data-plane", action="store_true",
+                        help="run ONLY the input-bound data-plane "
+                             "comparison: the same step fed "
+                             "synchronously vs through the "
+                             "PrefetchLoader, with data-wait fraction "
+                             "(docs/DATA.md)")
+    parser.add_argument("--data-delay-ms", type=float, default=30.0,
+                        help="synthetic per-batch storage latency for "
+                             "--data-plane (what makes the config "
+                             "input-bound)")
+    parser.add_argument("--prefetch-depth", type=int, default=3,
+                        help="PrefetchLoader queue depth for --data-plane")
     args = parser.parse_args()
     if args.repeats < 1:
         parser.error("--repeats must be >= 1")
-    if args.overlap and args.compression is not None:
-        parser.error("--overlap and --compression are separate comparison "
-                     "modes (the compression block already runs the "
-                     "overlapped pipeline); pass one of the two")
     if args.accum_steps < 1:
         parser.error("--accum-steps must be >= 1")
+    if args.data_plane and (args.overlap or args.compression is not None):
+        parser.error("--data-plane is its own comparison mode; run it "
+                     "separately from --overlap/--compression")
+
+    if args.data_plane:
+        data_plane_comparison(args)
+        return
 
     if args.overlap:
+        # with --compression too, the matrix gains the compressed
+        # overlap+ZeRO-1 variants (the full pipeline in one run)
         overlap_comparison(args)
         return
 

@@ -3,14 +3,16 @@
 The JAX counterpart of the reference's flagship real-data example
 (``examples/pytorch_imagenet_resnet50.py``): every rank
 
-* takes a DISJOINT shard of the dataset each epoch
-  (``horovod_tpu.data.DistributedSampler`` — the
-  ``torch.utils.data.distributed.DistributedSampler`` role), reshuffled
-  per epoch via ``set_epoch``,
+* takes a DISJOINT shard of the dataset each epoch through the data
+  plane's ``PrefetchLoader`` (docs/DATA.md): a background thread
+  assembles the next batch while the current step computes, and the
+  epoch-keyed shuffle stays deterministic per rank (the
+  ``torch.utils.data.distributed.DistributedSampler`` role),
 * computes gradients locally (jit-compiled), averages them across ranks
   with the fused eager allreduce,
 * follows the full checkpoint/resume discipline (rank-0 atomic writes,
-  broadcast restore — ``examples/keras_imagenet_resnet50.py:85-103``).
+  broadcast restore — ``examples/keras_imagenet_resnet50.py:85-103``),
+  repositioning the loader's cursor at the resume epoch.
 
 Real data: ``--data-dir DIR`` with ``train.npz`` containing ``images``
 (N, H, W, 3) uint8/float and ``labels`` (N,) int. Without it, a
@@ -103,15 +105,22 @@ def main():
             loss_fn, has_aux=True)(params)
         return loss, grads, stats
 
-    sampler = data.DistributedSampler(n, num_replicas=size, rank=rank)
+    # the data plane: per-rank disjoint shards, epoch-keyed reshuffle,
+    # and the NEXT batch assembled on a background thread while this
+    # one trains (docs/DATA.md). The cursor repositions the stream at
+    # the resume epoch — same mechanism that rides the checkpoint
+    # manifest under hvd.elastic.JaxState(loader=...).
+    loader = data.PrefetchLoader(
+        data.ArraySource([images, labels]), args.batch_size,
+        rank=rank, world=size, epochs=args.epochs)
+    if start:
+        cur = loader.cursor()
+        cur["epoch"] = start
+        loader.set_cursor(cur)
     for epoch in range(start, args.epochs):
-        sampler.set_epoch(epoch)  # new shuffle, still disjoint per rank
-        idx = np.fromiter(iter(sampler), dtype=np.int64)
-        idx = idx[:len(idx) - len(idx) % args.batch_size]  # full batches
         losses, seen = [], 0
-        for i in range(0, len(idx), args.batch_size):
-            b = idx[i:i + args.batch_size]
-            bx, by = images[b], labels[b]
+        for _ in range(loader.batches_remaining_in_epoch()):
+            bx, by = next(loader)
             loss, grads, batch_stats = grad_step(
                 params, batch_stats, jnp.asarray(bx),
                 jnp.asarray(by, jnp.int32))
@@ -130,6 +139,7 @@ def main():
         if rank == 0:
             print(f"epoch {epoch + 1}: loss {mean_loss:.4f} "
                   f"({seen * size} examples/epoch across {size} ranks)")
+    loader.close()
     print(f"rank {rank} done")
 
 

@@ -1,0 +1,51 @@
+"""The distributed data plane: per-rank sharding + the prefetch loader.
+
+Two layers, one subsystem (docs/DATA.md):
+
+* **Sharding** (``sharding.py``) — the functional core carried over from
+  the original ``data.py`` module: deterministic per-epoch permutations
+  split disjointly across ranks (``shard_indices``), the torch-sampler
+  protocol (``DistributedSampler``), ``shard_dataset`` for tf.data/grain,
+  and the ``local_batches`` convenience iterator. Everything importable
+  exactly as before — ``horovod_tpu.data`` is the same namespace.
+* **Loading** (``sources.py`` + ``loader.py``) — what the reference
+  lineage never had: :class:`PrefetchLoader` overlaps host batch
+  assembly AND the host→device transfer with the running step
+  (background producer, bounded queue), exposes a serializable cursor
+  that rides the checkpoint manifest for exact mid-epoch resume, and
+  re-shards the remaining sample space on elastic N→M membership
+  changes. :class:`ArraySource` / :class:`FileSource` are the two
+  shipped batch sources behind one index-addressed protocol.
+
+Integration points: ``training.make_train_step(loader=...)`` installs
+the step's mesh placement into the loader (batches land pre-sharded),
+``training.elastic_train_loop`` accepts a loader in place of
+``batch_fn``, and ``elastic.JaxState(loader=...)`` commits/restores the
+cursor with the model state. Telemetry: the ``hvd_data_*`` series
+(docs/OBSERVABILITY.md).
+"""
+
+from horovod_tpu.data.loader import (  # noqa: F401
+    CURSOR_VERSION,
+    PrefetchLoader,
+    epoch_order,
+    segment,
+)
+from horovod_tpu.data.sharding import (  # noqa: F401
+    DistributedSampler,
+    local_batches,
+    shard_dataset,
+    shard_indices,
+)
+from horovod_tpu.data.sources import (  # noqa: F401
+    ArraySource,
+    FileSource,
+    Source,
+)
+
+__all__ = [
+    "shard_indices", "DistributedSampler", "shard_dataset",
+    "local_batches",
+    "Source", "ArraySource", "FileSource",
+    "PrefetchLoader", "epoch_order", "segment", "CURSOR_VERSION",
+]

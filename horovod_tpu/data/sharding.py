@@ -123,14 +123,22 @@ def local_batches(arrays, batch_size, num_shards=None, shard_id=None, *,
     ``arrays`` is a sequence of equal-length arrays (images, labels, ...).
     Batch boundaries fall inside the rank's shard, so ranks never see
     overlapping examples; ``drop_last=True`` (default) keeps every step's
-    batch full — the SPMD-friendly choice (static shapes)."""
+    batch full — the SPMD-friendly choice (static shapes).
+
+    ``drop_last`` governs BOTH trims, consistently: the cross-shard tail
+    (``shard_indices`` would otherwise wrap-pad the shard, handing this
+    rank duplicated examples within one epoch) and the ragged final
+    batch. With ``drop_last=True`` an example therefore appears AT MOST
+    once per rank per epoch; with ``drop_last=False`` the wrap padding
+    keeps every example covered at the cost of a few duplicates near the
+    epoch tail (DistributedSampler semantics)."""
     arrays = [np.asarray(a) for a in arrays]
     n = len(arrays[0])
     for a in arrays:
         if len(a) != n:
             raise ValueError("all arrays must share their leading dim")
     idx = shard_indices(n, num_shards, shard_id, epoch=epoch,
-                        shuffle=shuffle, seed=seed)
+                        shuffle=shuffle, seed=seed, drop_last=drop_last)
     end = len(idx) - len(idx) % batch_size if drop_last else len(idx)
     for i in range(0, end, batch_size):
         b = idx[i:i + batch_size]

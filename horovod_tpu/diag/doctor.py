@@ -91,6 +91,23 @@ def _last_event(dump, kinds=None):
     return None
 
 
+def _data_state(dump):
+    """The input-pipeline picture of one rank's dump: the producer batch
+    still open (``data`` B with no matching E — the prefetch thread was
+    mid-assembly when the dump fired) and the consumer stall still open
+    (``data_wait`` B with no E — the TRAINING thread was starved). The
+    loaders emit both (horovod_tpu/data/loader.py), which is what lets
+    a data-stall verdict indict a named producer instead of guessing."""
+    open_batch = open_wait = None
+    for ev in dump.get("events") or []:
+        k = ev.get("k")
+        if k == "data":
+            open_batch = ev if ev.get("ph") == "B" else None
+        elif k == "data_wait":
+            open_wait = ev if ev.get("ph") == "B" else None
+    return open_batch, open_wait
+
+
 def _open_ckpt_saves(dump):
     """Checkpoint steps this rank BEGAN saving (``ckpt`` ph=B) with no
     matching commit/failure (ph=E) in the ring: saves the crash
@@ -127,12 +144,15 @@ def diagnose(dumps, expected_size=None):
         if (last and last.get("k") == "coll" and last.get("ph") == "E"
                 and last.get("ok") is False):
             failed = (last.get("seq"), last.get("op"))
+        open_batch, open_wait = _data_state(d)
         per_rank[r] = {
             "seq": d.get("collective_seq", 0),
             "completed": d.get("last_completed_seq", 0),
             "parked": _parked(d),
             "failed": failed,
             "last_event": last,
+            "data_open": open_batch,
+            "data_wait_open": open_wait,
             "dump_reasons": d.get("dump_reasons") or [],
             "config_crc": d.get("config_crc"),
             "host": d.get("host"),
@@ -220,10 +240,28 @@ def _classify(expected, dead, digest_view, per_rank, parked, clean):
                     f"no collective since, while rank(s) {sorted(parked)} "
                     f"wait in {'/'.join(parked_ops)}: stuck compiling or "
                     "dispatching")
-        return "data stall", (
+        detail = []
+        for r in idle:
+            wait = per_rank[r].get("data_wait_open")
+            prod = per_rank[r].get("data_open")
+            if wait:
+                detail.append(
+                    f"rank {r}'s training thread was starved waiting on "
+                    f"batch {wait.get('batch')} of epoch "
+                    f"{wait.get('epoch')} from its "
+                    f"{wait.get('source')} producer")
+            if prod:
+                detail.append(
+                    f"rank {r}'s producer ({prod.get('source')}) was "
+                    f"still assembling epoch {prod.get('epoch')} batch "
+                    f"{prod.get('batch')} when the dump fired")
+        why = (
             f"rank(s) {idle} finished their last step and never entered "
-            f"the next collective (input pipeline starved?) while rank(s) "
+            f"the next collective (input pipeline starved) while rank(s) "
             f"{sorted(parked)} wait in {'/'.join(parked_ops)}")
+        if detail:
+            why += "; " + "; ".join(detail)
+        return "data stall", why
     if parked:
         seqs = sorted({s for s, _op in parked.values()})
         return "collective hang", (
@@ -238,7 +276,7 @@ def _fmt_event(ev):
     parts = [f"{ev.get('t', 0):.6f}", f"rank {ev.get('rank')}",
              str(ev.get("k"))]
     for key in ("ph", "seq", "op", "name", "step", "reason", "signum",
-                "epoch"):
+                "epoch", "batch", "source"):
         if ev.get(key) is not None:
             parts.append(f"{key}={ev[key]}")
     if ev.get("ok") is False:

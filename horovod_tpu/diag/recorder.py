@@ -255,6 +255,17 @@ class FlightRecorder:
         finally:
             self._dump_lock.release()
 
+    def wait_for_dump(self, timeout=5.0):
+        """Block until an in-flight :meth:`dump` on ANOTHER thread has
+        finished. A thread about to terminate the process after its own
+        dump() skipped (the non-blocking lock was held) must wait here
+        first: the signal wakeup-fd watcher and the main-thread signal
+        handler both fire on one signal, and the loser re-raising the
+        fatal default disposition would otherwise kill the winner
+        mid-``json.dump`` — a torn tmp file and no black box at all."""
+        if self._dump_lock.acquire(timeout=timeout):
+            self._dump_lock.release()
+
 
 def _default_dump_dir():
     import tempfile
@@ -419,9 +430,14 @@ def _install_signal_path(rec, hooks, signals):
 
         def _handler(signum, frame, _prev=prev[sig]):
             # main-thread path: dump, then hand over to the previous
-            # behavior (user handler, ignore, or default termination)
+            # behavior (user handler, ignore, or default termination).
+            # The watcher thread races us on the same signal via the
+            # wakeup fd — if it holds the dump lock our dump() skips,
+            # and we must let its write FINISH before re-raising a
+            # fatal disposition that would tear it mid-file.
             rec.record("signal", signum=int(signum))
             rec.dump(reason=f"signal:{signum}")
+            rec.wait_for_dump()
             if _prev is signal.SIG_IGN:
                 return  # the app chose to survive this signal; honor it
             if callable(_prev):

@@ -246,10 +246,19 @@ class JaxState(ObjectState):
       ones without touching disk.
     * **flush** — forces in-flight async saves to durability; the
       elastic loop calls it before every re-rendezvous.
+
+    A ``horovod_tpu.data.PrefetchLoader`` attached via ``loader=`` (or
+    :meth:`attach_loader`) makes the INPUT position part of the state:
+    its cursor is captured at every commit, persisted in the checkpoint
+    MANIFEST (``meta["data_cursor"]``), rolled back by ``restore()``
+    (a retried batch replays the same examples), adopted from the
+    elected root by ``sync()``, and re-sharded over the new membership
+    on ``reset()`` — docs/DATA.md.
     """
 
     def __init__(self, directory=None, keep=3, notification_manager=None,
-                 async_save=True, checkpoint_every=1, **kwargs):
+                 async_save=True, checkpoint_every=1, loader=None,
+                 **kwargs):
         super().__init__(notification_manager=notification_manager,
                          **kwargs)
         self._directory = directory
@@ -258,6 +267,17 @@ class JaxState(ObjectState):
         self.checkpoint_every = max(1, int(checkpoint_every))
         self._commit_count = 0
         self._ckpt = None
+        self._loader = loader
+        self._saved_cursor = None
+
+    def attach_loader(self, loader):
+        """Adopt ``loader``'s cursor into the commit/restore/sync cycle
+        (idempotent; ``training.elastic_train_loop`` calls this when
+        handed a loader). If a cursor was already restored from disk —
+        the loader arrived after ``restore()`` — it is applied now."""
+        self._loader = loader
+        if loader is not None and self._saved_cursor is not None:
+            loader.set_cursor(self._saved_cursor)
 
     def _capture(self):
         # a REAL host copy, ZeroState included (it is a registered
@@ -296,16 +316,23 @@ class JaxState(ObjectState):
 
     def save(self):
         self._saved_state = self._capture()
+        if self._loader is not None:
+            self._saved_cursor = self._loader.cursor()
         self._commit_count += 1
         if self._directory and \
                 self._commit_count % self.checkpoint_every == 0:
+            meta = {"commit": self._commit_count}
+            if self._saved_cursor is not None:
+                # the input position rides the manifest so a restore
+                # resumes the batch stream exactly where this commit
+                # left it (docs/DATA.md)
+                meta["data_cursor"] = self._saved_cursor
             # hand the writer the capture itself: it is already host
             # numpy (ZeroState structure preserved by tree_map), so the
             # snapshot half's device_get degrades to a no-op instead of
             # pulling the live device tree a second time per commit
             self._checkpointer().save(
-                self._commit_count, self._saved_state,
-                meta={"commit": self._commit_count},
+                self._commit_count, self._saved_state, meta=meta,
                 block=not self._async_save)
 
     def flush(self, timeout=None):
@@ -321,6 +348,22 @@ class JaxState(ObjectState):
         if self._saved_state is None:
             self._restore_from_disk()
         super().restore()
+        if self._loader is not None and self._saved_cursor is not None:
+            # roll the input position back WITH the model state: the
+            # retried steps replay the exact batches of the discarded
+            # ones
+            self._loader.set_cursor(self._saved_cursor)
+
+    def reset(self):
+        super().reset()
+        if self._loader is not None:
+            try:
+                # membership changed: re-shard the REMAINING sample
+                # space across the new world (docs/DATA.md)
+                self._loader.on_reset()
+            except Exception:  # noqa: BLE001 — never block recovery
+                logger.warning("elastic: loader reshard on reset failed",
+                               exc_info=True)
 
     def _restore_from_disk(self):
         if not self._directory:
@@ -335,6 +378,8 @@ class JaxState(ObjectState):
                 self._directory, target)
             self._saved_state = restored
             self._commit_count = int(meta.get("commit", step))
+            self._saved_cursor = meta.get("data_cursor") \
+                or self._saved_cursor
             logger.info("elastic: restored commit %d from sharded "
                         "checkpoint %s", self._commit_count,
                         self._directory)
@@ -351,6 +396,7 @@ class JaxState(ObjectState):
                                                 restored[k])
                              for k in self._state_keys}
         self._commit_count = int(meta.get("commit", steps[-1]))
+        self._saved_cursor = meta.get("data_cursor") or self._saved_cursor
         logger.info("elastic: restored commit %d from %s",
                     self._commit_count, self._directory)
         return True
@@ -369,7 +415,46 @@ class JaxState(ObjectState):
         # numbers, a two-phase commit barrier that can never complete
         self._commit_count = int(np.asarray(_broadcast_tree(
             np.asarray(self._commit_count, dtype=np.int64), root)))
+        self._sync_cursor(root)
         return root
+
+    def _sync_cursor(self, root):
+        """Adopt ``root``'s committed data cursor (JSON over the
+        collective plane: a length broadcast sizes the byte buffer, so
+        ranks never need matching local payloads). A newcomer that
+        joined without disk access still resumes the batch stream at
+        the survivors' position."""
+        import json as _json
+        if self._loader is None:
+            # no data plane on this state: skip the exchange. The
+            # branch must be UNIFORM across ranks or the length
+            # broadcast wedges — loader attachment is part of the
+            # training program (same on every rank), unlike
+            # _saved_cursor, which a disk restore can set on some
+            # ranks only (e.g. loaderless jobs reading loader-era
+            # manifests).
+            return
+        payload = b""
+        if self._saved_cursor is not None:
+            payload = _json.dumps(self._saved_cursor,
+                                  sort_keys=True).encode()
+        length = int(np.asarray(_broadcast_tree(
+            np.asarray(len(payload), dtype=np.int64), root)))
+        if length <= 0:
+            return
+        buf = (np.frombuffer(payload, dtype=np.uint8).copy()
+               if len(payload) == length
+               else np.zeros(length, dtype=np.uint8))
+        buf = np.asarray(_broadcast_tree(buf, root))
+        try:
+            cur = _json.loads(bytes(bytearray(buf)).decode())
+        except (ValueError, UnicodeDecodeError):
+            logger.warning("elastic: undecodable data cursor from "
+                           "rank %s; keeping the local one", root)
+            return
+        self._saved_cursor = cur
+        if self._loader is not None:
+            self._loader.set_cursor(cur)
 
 
 def _leaf_dict(tree):

@@ -13,7 +13,9 @@ Chrome-trace counter events merged across ranks (``merge.py`` +
 
 from horovod_tpu.telemetry import instruments  # noqa: F401
 from horovod_tpu.telemetry.instruments import (  # noqa: F401
+    DataInstruments,
     StepInstruments,
+    data_instruments,
     enabled,
     install_compile_listeners,
     record_bucket,
@@ -31,7 +33,8 @@ from horovod_tpu.telemetry.server import MetricsServer  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
-    "MetricsServer", "StepInstruments", "enabled",
+    "MetricsServer", "StepInstruments", "DataInstruments",
+    "data_instruments", "enabled",
     "install_compile_listeners", "record_collective", "record_bucket",
     "load_events", "merge_traces", "instruments",
 ]

@@ -54,6 +54,12 @@ CKPT_SAVE_SECONDS = "hvd_ckpt_save_seconds"
 CKPT_BLOCKING_SECONDS = "hvd_ckpt_blocking_seconds"
 CKPT_BYTES_WRITTEN = "hvd_ckpt_bytes_written"
 CKPT_INFLIGHT = "hvd_ckpt_inflight"
+# -- data plane (horovod_tpu/data prefetch loaders) -------------------------
+DATA_WAIT_SECONDS = "hvd_data_wait_seconds"
+DATA_QUEUE_DEPTH = "hvd_data_queue_depth"
+DATA_BYTES_STAGED = "hvd_data_bytes_staged_total"
+DATA_BATCHES = "hvd_data_batches_total"
+DATA_LOAD_SECONDS = "hvd_data_load_seconds"
 
 
 def enabled(env=None):
@@ -326,6 +332,41 @@ class CkptInstruments:
 
 def ckpt_instruments(registry=None):
     return CkptInstruments(registry)
+
+
+class DataInstruments:
+    """The prefetch loader's instruments (docs/DATA.md): the seconds the
+    TRAINING thread blocked waiting for a batch (the number the prefetch
+    design minimizes — in a healthy pipeline it is ~0 and step time is
+    pure compute), the producer-side assembly+staging time per batch,
+    the prefetch queue depth after each fetch (persistently 0 = the
+    producer can't keep up; ~depth = compute-bound, the good case), and
+    the cumulative batches / bytes staged onto device."""
+
+    def __init__(self, registry=None):
+        r = registry if registry is not None else get_registry()
+        self.wait_seconds = r.histogram(
+            DATA_WAIT_SECONDS,
+            "Seconds the training thread blocked waiting for the next "
+            "batch (0 when the prefetch queue had one ready)")
+        self.load_seconds = r.histogram(
+            DATA_LOAD_SECONDS,
+            "Producer-thread seconds to assemble + stage one batch "
+            "(source gather, host->device placement)")
+        self.queue_depth = r.gauge(
+            DATA_QUEUE_DEPTH,
+            "Prefetched batches still queued right after a fetch "
+            "(0 persistently = input-bound, ~depth = compute-bound)")
+        self.bytes_staged = r.counter(
+            DATA_BYTES_STAGED,
+            "Cumulative bytes of batch data staged by the prefetch "
+            "producer (host numpy width, pre-placement)")
+        self.batches = r.counter(
+            DATA_BATCHES, "Batches delivered to the training thread")
+
+
+def data_instruments(registry=None):
+    return DataInstruments(registry)
 
 
 def stalled_ranks_gauge(registry=None):

@@ -113,13 +113,23 @@ def _placer(mesh, spec):
 def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                     batch_axes=None, donate=True, dropout_seed=0,
                     accum_steps=1, overlap_grads=False, telemetry=None,
-                    error_feedback=True):
+                    error_feedback=True, loader=None):
     """Build a jitted SPMD classification train step.
 
     Returns ``step(state, inputs, labels) -> (state, loss)`` where
     ``inputs``/``labels`` are global arrays whose leading (batch) dim is
     sharded over the data axes and ``state`` is replicated (ZeRO-sharded
-    optimizer state excepted). Gradients are allreduced by ``tx`` (wrap
+    optimizer state excepted).
+
+    ``loader`` (a ``horovod_tpu.data.PrefetchLoader``) wires the data
+    plane in: the step's own mesh placement (``device_put`` to the data
+    axes) is installed into the loader, so batches are staged onto
+    device BY THE PREFETCH THREAD while the previous step runs, and
+    ``step(state)`` with no batch arguments pulls ``(inputs, labels)``
+    from the loader (recording ``hvd_data_wait_seconds`` for any stall).
+    The loader only changes who feeds the program, never the program:
+    the compiled step is byte-identical with and without one
+    (tests/test_data_plane.py). Gradients are allreduced by ``tx`` (wrap
     with ``hvd.DistributedOptimizer``); BN stats are averaged across shards
     (per-shard normalization like the reference, one consistent stats copy
     for checkpointing); loss is averaged.
@@ -431,6 +441,25 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     def place_state(state):
         return _placer(mesh, state_specs(state))(state)
 
+    if loader is not None:
+        # stage prefetched batches straight to this step's mesh placement
+        # on the PRODUCER thread — by dispatch time place_data is a no-op
+        loader.attach_placement(place_data)
+
+    def _loader_batch():
+        if loader is None:
+            raise TypeError(
+                "step(state) with no batch needs a loader — build the "
+                "step with make_train_step(..., loader=...) or pass "
+                "(inputs, labels) explicitly")
+        batch = next(loader)
+        if not (isinstance(batch, (tuple, list)) and len(batch) == 2):
+            raise TypeError(
+                "the loader's source must yield (inputs, labels) "
+                f"batches for this step; got {type(batch).__name__} "
+                f"of {len(batch) if hasattr(batch, '__len__') else '?'}")
+        return batch[0], batch[1]
+
     _wire_holder = [None]
 
     def _wire_state_for(state):
@@ -486,11 +515,13 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     if not tele_on:
         _step_no = [0]
 
-        def step(state, inputs, labels):
+        def step(state, inputs=None, labels=None):
             # flight-recorder step boundaries (host-side only: with no
             # recorder installed these are a None check each, and they
             # never touch the traced computation — the compiled program
             # stays byte-identical either way, tests/test_diag.py)
+            if inputs is None:
+                inputs, labels = _loader_batch()
             n = _step_no[0]
             _step_no[0] = n + 1
             _check_wire_drift()
@@ -516,7 +547,9 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
         instruments = telemetry_lib.StepInstruments(accum_steps=accum_steps)
         first_trace = [True]
 
-        def step(state, inputs, labels):
+        def step(state, inputs=None, labels=None):
+            if inputs is None:
+                inputs, labels = _loader_batch()
             step_no = int(instruments.steps.value)
             _check_wire_drift()
             _flightrec.step_begin(step_no)
@@ -558,6 +591,8 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
 
     step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
     step.reset_error_feedback = _reset_error_feedback
+    step.loader = loader
+    step.place_data = place_data
 
     def lower(state, inputs, labels):
         """AOT lower with the SAME placement the executed path uses, so
@@ -580,9 +615,15 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
     honored at commit boundaries, and a worker failure rolls back to the
     last commit before retrying.
 
-    ``batch_fn(step) -> (inputs, labels)`` supplies data (step-indexed so
-    a restored worker re-reads the right batch); ``on_step(step, loss)``
-    is an optional observer. Returns the final ``TrainState``.
+    ``batch_fn`` supplies data two ways: a callable ``batch_fn(step) ->
+    (inputs, labels)`` (step-indexed so a restored worker re-reads the
+    right batch), or a ``horovod_tpu.data.PrefetchLoader`` — then the
+    loop pulls prefetched batches, attaches the loader to
+    ``elastic_state`` (when it is a ``JaxState``) so the loader's
+    cursor commits, restores and re-syncs WITH the model state, and a
+    rollback after a worker failure replays the exact batches of the
+    rolled-back steps. ``on_step(step, loss)`` is an optional observer.
+    Returns the final ``TrainState``.
 
     ``checkpoint_every=K`` sets the DISK cadence independently of the
     in-memory ``commit_every``: every K-th commit is persisted through
@@ -610,6 +651,12 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
                 "checkpoint directory (JaxState(directory=...))")
         elastic_state.checkpoint_every = max(1, int(checkpoint_every))
 
+    loader = (batch_fn if hasattr(batch_fn, "cursor")
+              and hasattr(batch_fn, "__next__") else None)
+    if loader is not None and hasattr(elastic_state, "attach_loader"):
+        # cursor rides the commit/restore/sync/manifest machinery
+        elastic_state.attach_loader(loader)
+
     own_instruments = None
     if telemetry_lib.enabled() and not hasattr(train_step, "instruments"):
         own_instruments = telemetry_lib.StepInstruments()
@@ -629,7 +676,10 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
     @_elastic.run
     def _loop(state):
         while _step_of(state.train_state) < num_steps:
-            inputs, labels = batch_fn(_step_of(state.train_state))
+            if loader is not None:
+                inputs, labels = next(loader)
+            else:
+                inputs, labels = batch_fn(_step_of(state.train_state))
             t0 = _time.perf_counter()
             new_ts, loss = train_step(state.train_state, inputs, labels)
             if own_instruments is not None:

@@ -128,3 +128,31 @@ def test_repeat_throughput_propagates_window_times():
     for rate, dt in runs:
         assert isinstance(dt, benchmarks.WindowTime)
         assert rate > 0
+
+
+def test_overlap_variants_extend_with_wire_formats():
+    """The --overlap/--compression combined mode (ISSUE 7 satellite):
+    bare --overlap keeps the three-variant matrix; adding --compression
+    appends an overlap+ZeRO-1 variant per wire format (the full
+    pipeline in one run); a bogus format dies before any compile."""
+    import sys
+
+    import pytest
+
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import bench
+
+    base, fmts = bench.overlap_variants(None)
+    assert list(base) == ["baseline_fused_ar", "overlap_rs",
+                          "overlap_rs_zero1"]
+    assert fmts == []
+    combined, fmts = bench.overlap_variants(["none", "int8", "fp8"])
+    assert fmts == ["int8", "fp8"]
+    assert combined["overlap_rs_zero1_int8"] == dict(
+        sharded=True, overlap=True, wire="int8")
+    assert combined["overlap_rs_zero1_fp8"]["wire"] == "fp8"
+    # bare --compression (empty list) means the full format sweep
+    _, fmts = bench.overlap_variants([])
+    assert fmts == ["bf16", "fp8", "int8"]
+    with pytest.raises(Exception):
+        bench.overlap_variants(["float3"])

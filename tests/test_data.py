@@ -104,6 +104,32 @@ def test_local_batches_disjoint_across_ranks():
     assert sorted(seen) == list(range(24))
 
 
+def test_local_batches_drop_last_never_duplicates_within_epoch():
+    """Regression (ISSUE 7 satellite): ``drop_last=True`` must thread
+    through to ``shard_indices`` — previously the shard was wrap-padded
+    FIRST, so with n % num_shards != 0 the job trained on duplicated
+    examples in the same epoch (the padded tail re-issues head examples
+    to other ranks) despite asking for the trimming semantics."""
+    xs = np.arange(10, dtype=np.float32)  # 10 % 4 != 0 -> pad or trim
+    seen = []
+    for r in range(4):
+        for (bx,) in data.local_batches([xs], batch_size=1, num_shards=4,
+                                        shard_id=r, shuffle=True,
+                                        epoch=0, drop_last=True):
+            seen.extend(bx.tolist())
+    assert len(seen) == 8  # tail trimmed, not padded
+    assert len(seen) == len(set(seen)), \
+        f"epoch trained duplicated examples: {sorted(seen)}"
+    # drop_last=False keeps the wrap-padded full-coverage semantics
+    all_seen = []
+    for r in range(4):
+        for (bx,) in data.local_batches([xs], batch_size=3, num_shards=4,
+                                        shard_id=r, shuffle=False,
+                                        drop_last=False):
+            all_seen.extend(bx.tolist())
+    assert set(all_seen) == set(xs.tolist())
+
+
 def test_world_defaults_without_init():
     import horovod_tpu as hvd
     hvd.shutdown()  # another module's test may have left hvd live

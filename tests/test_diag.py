@@ -456,3 +456,63 @@ def test_two_rank_roundtrip_recorder_dump_doctor(tmp_path):
     # both ranks dispatched the same schedule: no desync, no stragglers
     assert report["desync"]["desynced"] == []
     assert report["last_common_seq"] >= 3
+
+
+# ---- signal-dump vs watcher race (ISSUE 7 satellite fix) -----------------
+
+def test_wait_for_dump_blocks_until_inflight_dump_finishes(tmp_path):
+    """``wait_for_dump`` must not return while another thread holds the
+    dump lock — the main-thread signal handler calls it before
+    re-raising a fatal signal, so the watcher's racing dump can finish
+    instead of being torn mid-write."""
+    import threading
+    import time as _time
+
+    rec = FlightRecorder(capacity=8, rank=0, size=1,
+                         dump_dir=str(tmp_path))
+    assert rec._dump_lock.acquire(blocking=False)  # "watcher mid-dump"
+    released = []
+
+    def release_later():
+        _time.sleep(0.2)
+        released.append(True)
+        rec._dump_lock.release()
+
+    threading.Thread(target=release_later, daemon=True).start()
+    t0 = _time.perf_counter()
+    rec.wait_for_dump(timeout=5.0)
+    assert _time.perf_counter() - t0 >= 0.15
+    assert released  # we really waited for the holder, not a timeout
+
+
+def test_sigterm_in_interruptible_wait_still_dumps(tmp_path):
+    """Regression: SIGTERM landing while the main thread sits in an
+    interruptible Python wait (e.g. blocked on a starved data loader)
+    fires BOTH dump paths — the main-thread handler and the wakeup-fd
+    watcher. The handler used to skip (lock held) and immediately
+    re-raise the fatal default, SIGTERM-killing the watcher mid-write:
+    exit 143 and NO dump at all. The handler now waits for the racing
+    dump to finish first."""
+    script = tmp_path / "sleeper.py"
+    script.write_text(textwrap.dedent("""
+        import os, signal, threading, time
+        from horovod_tpu.diag import recorder
+        recorder.install(dump_dir=os.environ["DUMP_DIR"], rank=0, size=1)
+        threading.Timer(0.5, lambda: os.kill(
+            os.getpid(), signal.SIGTERM)).start()
+        time.sleep(30)
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DUMP_DIR"] = str(tmp_path)
+    rv = subprocess.run([sys.executable, str(script)], env=env,
+                        capture_output=True, text=True, timeout=60)
+    # the signal's intent is honored: death by SIGTERM...
+    assert rv.returncode in (-signal.SIGTERM, 128 + signal.SIGTERM)
+    # ...but the black box exists and names the signal
+    path = tmp_path / "flightrec.rank0.json"
+    assert path.is_file(), rv.stderr
+    with open(path) as f:
+        dump = json.load(f)
+    assert any(r.startswith("signal:15") for r in dump["dump_reasons"])
