@@ -257,6 +257,7 @@ def overlap_comparison(args):
                     result["step_ms_baseline_fused_ar"] /
                     result[f"step_ms_{name}"], 3)
     result["telemetry"] = _telemetry_block()
+    _attach_goodput(result)
     print(json.dumps(result))
 
 
@@ -335,6 +336,7 @@ def compression_comparison(args):
                 result[f"speedup_{name}_vs_none"] = round(
                     result["step_ms_none"] / result[f"step_ms_{name}"], 3)
     result["telemetry"] = _telemetry_block()
+    _attach_goodput(result)
     print(json.dumps(result))
 
 
@@ -456,6 +458,7 @@ def data_plane_comparison(args):
     if fam is not None:
         result["bytes_staged_total"] = int(fam.value)
     result["telemetry"] = _telemetry_block()
+    _attach_goodput(result)
     print(json.dumps(result))
 
 
@@ -471,10 +474,31 @@ def _telemetry_block():
     a regression to wire volume / bucket structure without rerunning."""
     from horovod_tpu import telemetry
     snap = telemetry.get_registry().snapshot()
-    keep = ("horovod_collective", "horovod_bucket", "horovod_step",
-            "horovod_examples", "horovod_compile", "hvd_wire", "hvd_data")
+    keep = ("hvd_collective", "hvd_bucket", "hvd_step",
+            "hvd_examples", "hvd_compile", "hvd_wire", "hvd_data")
     return {k: v for k, v in sorted(snap.items())
             if k.startswith(keep)}
+
+
+def _attach_goodput(result):
+    """The BENCH ``goodput`` block: the run ledger's phase breakdown
+    with the *sum ≈ 100% of wall* invariant ENFORCED — an unattributed
+    gap >2% of wall is a loud error (stderr + a ``goodput_error`` field),
+    never silence, so perf regressions stay attributable
+    (docs/OBSERVABILITY.md, "Where did my time go")."""
+    import sys
+
+    from horovod_tpu.telemetry import ledger as ledger_lib
+    from horovod_tpu.telemetry import report as report_mod
+    if not ledger_lib.get_ledger().enabled:
+        return  # HOROVOD_GOODPUT=0 is an opt-out, not a violation
+    try:
+        result["goodput"] = report_mod.goodput_block()
+    except report_mod.GoodputInvariantError as e:
+        print(f"bench: GOODPUT INVARIANT VIOLATED: {e}", file=sys.stderr)
+        result["goodput_error"] = str(e)
+    except Exception as e:  # noqa: BLE001 — record, don't die
+        result["goodput_error"] = (str(e) or repr(e)).splitlines()[0][:160]
 
 
 def _checkpoint_block(nbytes=32 << 20):
@@ -785,6 +809,7 @@ def main():
     except Exception as e:  # noqa: BLE001 — record, don't die
         result["checkpoint_error"] = str(e).splitlines()[0][:160]
     result["telemetry"] = _telemetry_block()
+    _attach_goodput(result)
     print(json.dumps(result))
 
 

@@ -48,6 +48,7 @@ class _State:
         self.stall_inspector = None
         self.metrics_server = None
         self.flight_recorder = None
+        self.ledger = None  # goodput time ledger (telemetry/ledger.py)
         self.joined = False
 
 

@@ -124,6 +124,7 @@ class AsyncCheckpointer:
             raise
         blocking = time.perf_counter() - t0
         self._metrics.blocking_seconds.observe(blocking)
+        self._charge_goodput(blocking)
         self._ensure_thread()
         self._queue.put((int(step), payload, zero_info, meta, t0))
         if block:
@@ -133,18 +134,33 @@ class AsyncCheckpointer:
     def flush(self, timeout=None):
         """Block until every queued save has committed; re-raise the
         first background failure. Call before a rendezvous, a restore,
-        or process exit."""
+        or process exit. The wait blocks the calling (training) thread,
+        so it is charged to the goodput ledger's ``ckpt_stall`` phase
+        alongside ``hvd_ckpt_blocking_seconds``."""
+        t0 = time.perf_counter()
         deadline = (time.monotonic() + timeout) if timeout is not None \
             else None
-        with self._lock:
-            while self._inflight > 0 and self._error is None:
-                if deadline is not None and time.monotonic() >= deadline:
-                    raise TimeoutError(
-                        f"ckpt flush: {self._inflight} save(s) still in "
-                        f"flight after {timeout:.0f}s")
-                self._lock.wait(0.01)
+        try:
+            with self._lock:
+                while self._inflight > 0 and self._error is None:
+                    if deadline is not None \
+                            and time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"ckpt flush: {self._inflight} save(s) still "
+                            f"in flight after {timeout:.0f}s")
+                    self._lock.wait(0.01)
+        finally:
+            self._charge_goodput(time.perf_counter() - t0)
         self._reraise()
         return self.last_manifest
+
+    @staticmethod
+    def _charge_goodput(seconds):
+        try:
+            from horovod_tpu.telemetry import ledger as _ledger_lib
+            _ledger_lib.get_ledger().charge("ckpt_stall", seconds)
+        except Exception:  # accounting must never break a save path
+            pass
 
     def close(self, timeout=None):
         """Flush (best effort) and stop the background thread."""

@@ -49,6 +49,7 @@ import time
 import numpy as np
 
 from horovod_tpu.data import sharding
+from horovod_tpu.telemetry import ledger as _ledger
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -324,6 +325,10 @@ class PrefetchLoader:
             self._halt_producer()
             raise StopIteration
         self._metrics.wait_seconds.observe(waited)
+        # the wait blocked the TRAINING thread: the goodput ledger books
+        # it as data_wait instead of letting it masquerade as compute in
+        # the next step settle (docs/OBSERVABILITY.md)
+        _ledger.get_ledger().charge("data_wait", waited)
         self._metrics.queue_depth.set(q.qsize())
         self._metrics.batches.inc()
         self._epoch, self._offset, self._batch_index = after

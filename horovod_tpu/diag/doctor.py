@@ -366,5 +366,19 @@ def main(argv=None):
     return 2 if report is None else 0
 
 
+def doctor_cli(argv=None):
+    """The ``hvd-doctor`` entry point: ``hvd-doctor [hang] <logdir>``
+    runs this module's hang/crash report; ``hvd-doctor perf <logdir>``
+    runs the goodput time-attribution report
+    (``horovod_tpu.telemetry.report``) over the same dump directory."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "perf":
+        from horovod_tpu.telemetry import report
+        return report.main(argv[1:])
+    if argv and argv[0] == "hang":
+        argv = argv[1:]
+    return main(argv)
+
+
 if __name__ == "__main__":
     sys.exit(main())

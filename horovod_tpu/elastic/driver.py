@@ -149,6 +149,10 @@ class ElasticDriver:
         self._m_straggler = reg.gauge(
             _tele.STRAGGLER_RATIO, "Slowest/median per-rank median step "
             "time across the current epoch's workers")
+        self._m_goodput = reg.gauge(
+            _tele.GOODPUT_RATIO, "Fleet-wide goodput: summed compute "
+            "seconds / summed attributed seconds across the workers' "
+            "per-rank goodput ledgers (KV heartbeat snapshots)")
 
     # -- membership ----------------------------------------------------------
     def available_hosts(self):
@@ -283,12 +287,13 @@ class ElasticDriver:
         the coordinator's view of the epoch: per-rank step progress and
         step-time medians, the slowest/median step-time ratio, and the
         flagged straggler ranks (ratio > ``STRAGGLER_THRESHOLD``).
-        Updates the ``horovod_straggler_step_time_ratio`` gauge and logs
+        Updates the ``hvd_straggler_step_time_ratio`` gauge and logs
         flagged ranks (rate-limited to once per epoch per rank)."""
         progress = self.worker_progress()
         view = {"epoch": self.epoch, "ranks": {}, "stragglers": [],
-                "straggler_ratio": None}
+                "straggler_ratio": None, "goodput": None}
         step_times = {}
+        fleet_phases = {}
         for rank, hb in progress.items():
             m = hb.get("metrics") or {}
             view["ranks"][rank] = {
@@ -297,6 +302,18 @@ class ElasticDriver:
             t = m.get("step_seconds_p50")
             if t:
                 step_times[rank] = float(t)
+            for phase, secs in (m.get("goodput") or {}).items():
+                fleet_phases[phase] = fleet_phases.get(phase, 0.0) \
+                    + float(secs)
+        if fleet_phases:
+            # the live fleet-wide goodput gauge: per-rank ledger phase
+            # totals ride the heartbeats (instruments.kv_snapshot), the
+            # driver just sums rank-seconds
+            attributed = sum(fleet_phases.values())
+            ratio = (fleet_phases.get("compute", 0.0) / attributed
+                     if attributed > 0 else 1.0)
+            view["goodput"] = {"phases": fleet_phases, "ratio": ratio}
+            self._m_goodput.set(ratio)
         if len(step_times) >= 2:
             ordered = sorted(step_times.values())
             # LOWER median: with the upper-middle element, a 2-worker

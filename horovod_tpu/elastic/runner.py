@@ -44,15 +44,30 @@ def run(func=None, *, retryable=()):
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
         from horovod_tpu.elastic.driver import EXIT_RENDEZVOUS
+        from horovod_tpu.telemetry import ledger as ledger_lib
         reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT",
                                          "0") or 0)
         resets = 0
         first = True
+
+        def _recovery_bracket(in_recovery):
+            # recovery time (reset/restore/resync after the FIRST
+            # iteration) is a first-class goodput phase, and the open
+            # bracket flips /healthz to 503 with phase="re-rendezvous"
+            # while the rank is parked here (docs/OBSERVABILITY.md)
+            if not in_recovery:
+                import contextlib
+                return contextlib.nullcontext()
+            return ledger_lib.get_ledger().phase(
+                "re-rendezvous", charge="rendezvous_recovery")
+
         while True:
             if not first:
-                state.on_reset()
+                with _recovery_bracket(True):
+                    state.on_reset()
             try:
-                state.sync()
+                with _recovery_bracket(not first):
+                    state.sync()
                 return func(state, *args, **kwargs)
             except HostsUpdatedInterrupt as e:
                 # progress is committed; only the world needs rebuilding
@@ -72,7 +87,8 @@ def run(func=None, *, retryable=()):
                         f"{reset_limit})") from e
                 logger.warning("elastic: worker failure (%s); restoring "
                                "last commit (reset %d)", e, resets)
-                state.restore()
+                with _recovery_bracket(True):
+                    state.restore()
                 first = False
 
     return wrapper

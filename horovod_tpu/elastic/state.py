@@ -368,6 +368,15 @@ class JaxState(ObjectState):
     def _restore_from_disk(self):
         if not self._directory:
             return False
+        # a rank rebuilding itself from a checkpoint is not serving:
+        # the bracket books the time as rendezvous_recovery and flips
+        # /healthz to 503 with phase="ckpt_restore" while it runs
+        from horovod_tpu.telemetry import ledger as ledger_lib
+        with ledger_lib.get_ledger().phase("ckpt_restore",
+                                           charge="rendezvous_recovery"):
+            return self._restore_from_disk_inner()
+
+    def _restore_from_disk_inner(self):
         from horovod_tpu import checkpoint
         from horovod_tpu import ckpt as ckpt_lib
         if self._ckpt is not None:

@@ -15,6 +15,7 @@ extensions).
 """
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +27,7 @@ from horovod_tpu.diag import recorder as _flightrec
 from horovod_tpu.ops.reduction import Adasum, Average, Max, Min, Sum
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.telemetry import instruments as _tele
+from horovod_tpu.telemetry import ledger as _ledger
 
 
 def _eager_recorded(op_name, fn, x, nbytes, hash_shape=True):
@@ -35,15 +37,23 @@ def _eager_recorded(op_name, fn, x, nbytes, hash_shape=True):
     the post-mortem analogue of the reference stall inspector's
     per-tensor missing-ranks view (``stall_inspector.cc``). No recorder
     installed -> two no-op calls. ``hash_shape=False`` keeps the operand
-    shape out of the desync digest for variable-length collectives."""
+    shape out of the desync digest for variable-length collectives.
+
+    The host time spent here is EXPOSED collective time — unlike the
+    compiled pipeline's collectives, nothing overlaps it — so it is
+    charged to the goodput ledger's ``exposed_collective`` phase
+    (trace-time dispatches never route through this funnel)."""
     seq = _flightrec.collective_enter(op_name, x, nbytes=nbytes,
                                       mode="eager", hash_shape=hash_shape)
     ok = False
+    t0 = time.perf_counter()
     try:
         out = fn()
         ok = True
         return out
     finally:
+        _ledger.get_ledger().charge("exposed_collective",
+                                    time.perf_counter() - t0)
         _flightrec.collective_exit(op_name, seq, ok=ok)
 
 

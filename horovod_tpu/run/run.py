@@ -169,6 +169,15 @@ def build_parser():
     p.add_argument("--no-doctor", action="store_true",
                    help="do not auto-run the doctor when a job exits "
                         "non-zero with flight-recorder dumps present")
+    p.add_argument("--goodput-report", metavar="LOGDIR", default=None,
+                   help="aggregate the goodput-ledger dumps "
+                        "(goodput.rank*.json, written next to the "
+                        "flight-recorder dumps at shutdown) under LOGDIR "
+                        "into the end-of-run time-attribution report "
+                        "(per-rank and fleet-wide phase breakdown, "
+                        "dominant time sink), then exit — same as "
+                        "hvd-doctor perf / python -m "
+                        "horovod_tpu.telemetry.report")
     p.add_argument("--merge-timeline", metavar="OUT", default=None,
                    help="merge per-rank Chrome trace files into one "
                         "Perfetto-loadable trace with aligned clocks and "
@@ -192,6 +201,7 @@ def parse_args(argv=None):
     # after the config overlay: the YAML may supply num-proc
     if (not args.check_build and not args.elastic
             and args.merge_timeline is None and args.doctor is None
+            and args.goodput_report is None
             and args.num_proc is None):
         parser.error("-np/--num-proc is required")
     return args
@@ -567,6 +577,9 @@ def main(argv=None):
         if args.num_proc:
             argv_d += ["--expected-size", str(args.num_proc)]
         return doctor_mod.main(argv_d)
+    if args.goodput_report is not None:
+        from horovod_tpu.telemetry import report as report_mod
+        return report_mod.main([args.goodput_report])
     if args.merge_timeline is not None:
         from horovod_tpu.telemetry import merge as merge_mod
         traces = [c for c in args.command if c != "--"]

@@ -54,6 +54,11 @@ def _rank_timeline_path(path, rank, size):
 def start(state):
     cfg = state.config
     native_core = bool(cfg.controller_addr and cfg.size > 1)
+    # fresh goodput ledger per run: every wall-clock second from here to
+    # shutdown gets attributed to a phase (telemetry/ledger.py); pure
+    # host-side bookkeeping, disabled with HOROVOD_GOODPUT=0
+    from horovod_tpu.telemetry import ledger as ledger_lib
+    state.ledger = ledger_lib.reset_run()
     # flight recorder first: the black box must be armed before the
     # services whose failures it is meant to explain (controller
     # handshake, mesh build) can crash the process
@@ -83,10 +88,19 @@ def start(state):
         def _health():
             reg = telemetry.get_registry()
             steps = reg.get(telemetry.instruments.STEP_TOTAL)
-            return {"rank": cfg.rank, "size": cfg.size,
-                    "step": int(steps.value) if steps is not None else 0}
+            health = {"rank": cfg.rank, "size": cfg.size,
+                      "step": int(steps.value) if steps is not None else 0}
+            # elastic transitions flip the probe to 503 (server.py):
+            # a rank parked in re-rendezvous or restoring a checkpoint
+            # reports the phase it is parked in instead of "ok"
+            phase = telemetry.get_ledger().active_health_label()
+            if phase is not None:
+                health["status"] = "recovering"
+                health["phase"] = phase
+            return health
 
         telemetry.install_compile_listeners()
+        telemetry.build_info_gauge(cfg)
         # the stalled-ranks gauge must be scrapeable even before (or
         # without) a StallInspector: 0 = nothing known to be stalled
         telemetry.instruments.stalled_ranks_gauge().set(0)
@@ -151,6 +165,22 @@ def start(state):
 
 
 def stop(state):
+    # the per-rank goodput dump rides shotgun with the flight-recorder
+    # dumps: goodput.rank<r>.json next to flightrec.rank<r>.json, so the
+    # end-of-run report (hvd-doctor perf / hvdrun --goodput-report) has
+    # one directory to read
+    try:
+        from horovod_tpu.telemetry import ledger as ledger_lib
+        led = getattr(state, "ledger", None) or ledger_lib.get_ledger()
+        if led.enabled and led.started:
+            dump_dir = (state.flight_recorder.dump_dir
+                        if state.flight_recorder is not None
+                        else state.config.flightrec_dir)
+            if dump_dir:
+                led.write_dump(dump_dir, state.config.rank)
+        state.ledger = None
+    except Exception:
+        logger.warning("goodput ledger dump failed", exc_info=True)
     if state.metrics_server is not None:
         state.metrics_server.stop()
         state.metrics_server = None

@@ -12,7 +12,7 @@ inspector warns when this worker's step outruns or lags the slowest/fastest
 reported step for longer than the warning threshold, and can raise to abort
 the job after the shutdown threshold.
 
-Telemetry: the inspector owns the ``horovod_stalled_ranks`` gauge — the
+Telemetry: the inspector owns the ``hvd_stalled_ranks`` gauge — the
 number of ranks currently past the warning threshold (from
 ``heartbeat_fn`` when a cluster view exists, else this rank's own 0/1).
 

@@ -12,15 +12,19 @@ Chrome-trace counter events merged across ranks (``merge.py`` +
 """
 
 from horovod_tpu.telemetry import instruments  # noqa: F401
+from horovod_tpu.telemetry import ledger  # noqa: F401
+from horovod_tpu.telemetry import report  # noqa: F401
 from horovod_tpu.telemetry.instruments import (  # noqa: F401
     DataInstruments,
     StepInstruments,
+    build_info_gauge,
     data_instruments,
     enabled,
     install_compile_listeners,
     record_bucket,
     record_collective,
 )
+from horovod_tpu.telemetry.ledger import TimeLedger, get_ledger  # noqa: F401
 from horovod_tpu.telemetry.merge import load_events, merge_traces  # noqa: F401
 from horovod_tpu.telemetry.registry import (  # noqa: F401
     Counter,
@@ -34,7 +38,8 @@ from horovod_tpu.telemetry.server import MetricsServer  # noqa: F401
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "MetricsServer", "StepInstruments", "DataInstruments",
-    "data_instruments", "enabled",
+    "data_instruments", "enabled", "build_info_gauge",
     "install_compile_listeners", "record_collective", "record_bucket",
-    "load_events", "merge_traces", "instruments",
+    "load_events", "merge_traces", "instruments", "ledger", "report",
+    "TimeLedger", "get_ledger",
 ]

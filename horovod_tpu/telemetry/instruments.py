@@ -19,36 +19,42 @@ import time
 
 from horovod_tpu.telemetry.registry import get_registry
 
+# Names are canonically ``hvd_*``. The catalogue used to mix
+# ``horovod_*`` (step/collective/elastic) and ``hvd_*`` (wire/ckpt/data)
+# prefixes; the old names remain available for ONE release as scrape-
+# time aliases (``LEGACY_ALIASES`` below, rendered by the registry with
+# a deprecation HELP line) and are then removed — re-point dashboards at
+# the ``hvd_*`` names (docs/OBSERVABILITY.md deprecation note).
 # -- step / training plane --------------------------------------------------
-STEP_TOTAL = "horovod_step_total"
-STEP_SECONDS = "horovod_step_latency_seconds"
-STEP_DISPATCH_SECONDS = "horovod_step_dispatch_seconds"
-MICROBATCH_SECONDS = "horovod_microbatch_seconds"
-EXAMPLES_TOTAL = "horovod_examples_total"
-EXAMPLES_PER_SEC = "horovod_examples_per_second"
-LOSS = "horovod_loss"
-GRAD_NORM = "horovod_grad_norm"
+STEP_TOTAL = "hvd_step_total"
+STEP_SECONDS = "hvd_step_latency_seconds"
+STEP_DISPATCH_SECONDS = "hvd_step_dispatch_seconds"
+MICROBATCH_SECONDS = "hvd_microbatch_seconds"
+EXAMPLES_TOTAL = "hvd_examples_total"
+EXAMPLES_PER_SEC = "hvd_examples_per_second"
+LOSS = "hvd_loss"
+GRAD_NORM = "hvd_grad_norm"
 # -- compilation ------------------------------------------------------------
-COMPILE_CACHE_HITS = "horovod_compile_cache_hits_total"
-COMPILE_CACHE_MISSES = "horovod_compile_cache_misses_total"
-COMPILE_SECONDS = "horovod_compile_seconds_total"
+COMPILE_CACHE_HITS = "hvd_compile_cache_hits_total"
+COMPILE_CACHE_MISSES = "hvd_compile_cache_misses_total"
+COMPILE_SECONDS = "hvd_compile_seconds_total"
 # -- collectives / fusion ---------------------------------------------------
-COLLECTIVE_CALLS = "horovod_collective_calls_total"
-COLLECTIVE_BYTES = "horovod_collective_bytes_total"
-COLLECTIVE_LOGICAL_BYTES = "horovod_collective_logical_bytes_total"
-BUCKET_FILL_RATIO = "horovod_bucket_fill_ratio"
-BUCKET_DISPATCH_SECONDS = "horovod_bucket_dispatch_seconds"
+COLLECTIVE_CALLS = "hvd_collective_calls_total"
+COLLECTIVE_BYTES = "hvd_collective_bytes_total"
+COLLECTIVE_LOGICAL_BYTES = "hvd_collective_logical_bytes_total"
+BUCKET_FILL_RATIO = "hvd_bucket_fill_ratio"
+BUCKET_DISPATCH_SECONDS = "hvd_bucket_dispatch_seconds"
 # -- wire compression (ops/compression.py + the fusion pipeline) ------------
 WIRE_BYTES = "hvd_wire_bytes_total"
 WIRE_LOGICAL_BYTES = "hvd_wire_logical_bytes_total"
 WIRE_COMPRESSION_RATIO = "hvd_wire_compression_ratio"
 # -- elastic ----------------------------------------------------------------
-RENDEZVOUS_EPOCHS = "horovod_rendezvous_epochs_total"
-BLACKLIST_HOSTS = "horovod_blacklist_hosts"
-RECOVERY_SECONDS = "horovod_recovery_seconds"
-STRAGGLER_RATIO = "horovod_straggler_step_time_ratio"
+RENDEZVOUS_EPOCHS = "hvd_rendezvous_epochs_total"
+BLACKLIST_HOSTS = "hvd_blacklist_hosts"
+RECOVERY_SECONDS = "hvd_recovery_seconds"
+STRAGGLER_RATIO = "hvd_straggler_step_time_ratio"
 # -- stall inspector --------------------------------------------------------
-STALLED_RANKS = "horovod_stalled_ranks"
+STALLED_RANKS = "hvd_stalled_ranks"
 # -- async sharded checkpointing (horovod_tpu/ckpt) -------------------------
 CKPT_SAVE_SECONDS = "hvd_ckpt_save_seconds"
 CKPT_BLOCKING_SECONDS = "hvd_ckpt_blocking_seconds"
@@ -60,6 +66,60 @@ DATA_QUEUE_DEPTH = "hvd_data_queue_depth"
 DATA_BYTES_STAGED = "hvd_data_bytes_staged_total"
 DATA_BATCHES = "hvd_data_batches_total"
 DATA_LOAD_SECONDS = "hvd_data_load_seconds"
+# -- goodput ledger (telemetry/ledger.py, docs/OBSERVABILITY.md) ------------
+TIME_SECONDS = "hvd_time_seconds_total"
+GOODPUT_RATIO = "hvd_goodput_ratio"
+# -- process identity -------------------------------------------------------
+BUILD_INFO = "hvd_build_info"
+
+# canonical -> deprecated name, served as scrape-time duplicates for one
+# release (the registry renders each aliased family twice)
+LEGACY_ALIASES = {
+    STEP_TOTAL: "horovod_step_total",
+    STEP_SECONDS: "horovod_step_latency_seconds",
+    STEP_DISPATCH_SECONDS: "horovod_step_dispatch_seconds",
+    MICROBATCH_SECONDS: "horovod_microbatch_seconds",
+    EXAMPLES_TOTAL: "horovod_examples_total",
+    EXAMPLES_PER_SEC: "horovod_examples_per_second",
+    LOSS: "horovod_loss",
+    GRAD_NORM: "horovod_grad_norm",
+    COMPILE_CACHE_HITS: "horovod_compile_cache_hits_total",
+    COMPILE_CACHE_MISSES: "horovod_compile_cache_misses_total",
+    COMPILE_SECONDS: "horovod_compile_seconds_total",
+    COLLECTIVE_CALLS: "horovod_collective_calls_total",
+    COLLECTIVE_BYTES: "horovod_collective_bytes_total",
+    COLLECTIVE_LOGICAL_BYTES: "horovod_collective_logical_bytes_total",
+    BUCKET_FILL_RATIO: "horovod_bucket_fill_ratio",
+    BUCKET_DISPATCH_SECONDS: "horovod_bucket_dispatch_seconds",
+    RENDEZVOUS_EPOCHS: "horovod_rendezvous_epochs_total",
+    BLACKLIST_HOSTS: "horovod_blacklist_hosts",
+    RECOVERY_SECONDS: "horovod_recovery_seconds",
+    STRAGGLER_RATIO: "horovod_straggler_step_time_ratio",
+    STALLED_RANKS: "horovod_stalled_ranks",
+}
+
+# every metric this framework registers, in catalogue order — the
+# contract tests/test_telemetry.py enforces against the table in
+# docs/OBSERVABILITY.md (both directions)
+CATALOGUE = (
+    STEP_TOTAL, STEP_SECONDS, STEP_DISPATCH_SECONDS, MICROBATCH_SECONDS,
+    EXAMPLES_TOTAL, EXAMPLES_PER_SEC, LOSS, GRAD_NORM,
+    COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES, COMPILE_SECONDS,
+    COLLECTIVE_CALLS, COLLECTIVE_BYTES, COLLECTIVE_LOGICAL_BYTES,
+    WIRE_BYTES, WIRE_LOGICAL_BYTES, WIRE_COMPRESSION_RATIO,
+    BUCKET_FILL_RATIO, BUCKET_DISPATCH_SECONDS,
+    RENDEZVOUS_EPOCHS, BLACKLIST_HOSTS, RECOVERY_SECONDS, STRAGGLER_RATIO,
+    STALLED_RANKS,
+    CKPT_BLOCKING_SECONDS, CKPT_SAVE_SECONDS, CKPT_BYTES_WRITTEN,
+    CKPT_INFLIGHT,
+    DATA_WAIT_SECONDS, DATA_LOAD_SECONDS, DATA_QUEUE_DEPTH,
+    DATA_BYTES_STAGED, DATA_BATCHES,
+    TIME_SECONDS, GOODPUT_RATIO, BUILD_INFO,
+)
+
+# the default registry serves the legacy names on every scrape until the
+# deprecation window closes
+get_registry().install_aliases(LEGACY_ALIASES)
 
 
 def enabled(env=None):
@@ -369,8 +429,55 @@ def data_instruments(registry=None):
     return DataInstruments(registry)
 
 
+def build_info_labels(config=None):
+    """The process's identity labels for ``hvd_build_info`` (and for the
+    goodput report header): framework version, jax version, backend,
+    world size. Values degrade to "unknown" rather than raising —
+    identity must never break startup."""
+    def safe(fn):
+        try:
+            return str(fn())
+        except Exception:
+            return "unknown"
+
+    def world():
+        if config is not None and getattr(config, "size", None):
+            return config.size
+        return int(os.environ.get("HOROVOD_SIZE", "1"))
+
+    def backend():
+        import jax
+        return jax.default_backend()
+
+    def version():
+        import horovod_tpu
+        return horovod_tpu.__version__
+
+    def jax_version():
+        import jax
+        return jax.__version__
+
+    return {"version": safe(version), "jax": safe(jax_version),
+            "backend": safe(backend), "world": safe(world)}
+
+
+def build_info_gauge(config=None, registry=None):
+    """Register the standard-practice ``hvd_build_info`` gauge: constant
+    1 with the identity as labels, so every scrape (and every dump that
+    embeds the labels) is self-describing."""
+    r = registry if registry is not None else get_registry()
+    labels = build_info_labels(config)
+    g = r.gauge(BUILD_INFO,
+                "Constant 1; the labels identify this build/process "
+                "(framework version, jax version, backend, world size)",
+                label_names=("version", "jax", "backend", "world"))
+    g.labels(labels["version"], labels["jax"], labels["backend"],
+             labels["world"]).set(1)
+    return g
+
+
 def stalled_ranks_gauge(registry=None):
-    """The one declaration of ``horovod_stalled_ranks`` — the stall
+    """The one declaration of ``hvd_stalled_ranks`` — the stall
     inspector records into it; ``runtime/services.py`` pre-registers it
     so scrapes expose 0 before (or without) an inspector."""
     r = registry if registry is not None else get_registry()
@@ -401,6 +508,17 @@ def kv_snapshot(registry=None):
         sample = cbytes.sample()
         if isinstance(sample, dict):
             out["collective_bytes"] = sum(sample.values())
+    # the goodput ledger's phase totals (telemetry/ledger.py) ride the
+    # same heartbeat so the driver's cluster_view can aggregate a live
+    # fleet-wide goodput gauge — nonzero phases only, rounded compact
+    tsec = r.get(TIME_SECONDS)
+    if tsec is not None:
+        sample = tsec.sample()
+        if isinstance(sample, dict):
+            phases = {lv[0]: round(v, 3) for lv, v in sample.items()
+                      if v > 0}
+            if phases:
+                out["goodput"] = phases
     return out
 
 
@@ -442,6 +560,11 @@ def install_compile_listeners():
             # positive compile times are meaningful to accumulate
             if "compil" in event and duration > 0:
                 compile_s.inc(duration)
+                # the goodput ledger books compilation out of the step
+                # interval it lands in (first dispatch), so a compile-
+                # heavy run cannot masquerade as compute
+                from horovod_tpu.telemetry import ledger as ledger_lib
+                ledger_lib.get_ledger().charge("compile", duration)
         except Exception:
             pass
 

@@ -115,9 +115,10 @@ class Counter(_Metric):
     def value(self):
         return self._self_child().value
 
-    def render(self, out):
+    def render(self, out, name=None):
+        name = name or self.name
         for lv, child in self._each():
-            out.append("%s%s %s" % (self.name,
+            out.append("%s%s %s" % (name,
                                     _fmt_labels(self.label_names, lv),
                                     _fmt(child.value)))
 
@@ -190,9 +191,10 @@ class Gauge(_Metric):
     def value(self):
         return self._self_child().value
 
-    def render(self, out):
+    def render(self, out, name=None):
+        name = name or self.name
         for lv, child in self._each():
-            out.append("%s%s %s" % (self.name,
+            out.append("%s%s %s" % (name,
                                     _fmt_labels(self.label_names, lv),
                                     _fmt(child.value)))
 
@@ -274,7 +276,8 @@ class Histogram(_Metric):
     def quantile(self, q):
         return self._self_child().quantile(q)
 
-    def render(self, out):
+    def render(self, out, name=None):
+        name = name or self.name
         for lv, child in self._each():
             with child._lock:
                 counts = list(child.counts)
@@ -284,16 +287,16 @@ class Histogram(_Metric):
                 cum += c
                 lv_le = lv + (_fmt(bound),)
                 out.append("%s_bucket%s %d" % (
-                    self.name,
+                    name,
                     _fmt_labels(self.label_names + ("le",), lv_le), cum))
             out.append("%s_bucket%s %d" % (
-                self.name,
+                name,
                 _fmt_labels(self.label_names + ("le",), lv + ("+Inf",)),
                 total))
             out.append("%s_sum%s %s" % (
-                self.name, _fmt_labels(self.label_names, lv), _fmt(s)))
+                name, _fmt_labels(self.label_names, lv), _fmt(s)))
             out.append("%s_count%s %d" % (
-                self.name, _fmt_labels(self.label_names, lv), total))
+                name, _fmt_labels(self.label_names, lv), total))
 
     def sample(self):
         def one(child):
@@ -314,6 +317,15 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics = {}
+        self._aliases = {}  # canonical name -> deprecated scrape alias
+
+    def install_aliases(self, aliases):
+        """Serve each canonical family under a deprecated name too
+        (scrape-time only: snapshots and heartbeats stay canonical).
+        The one-release migration path for the ``horovod_*`` ->
+        ``hvd_*`` rename (docs/OBSERVABILITY.md deprecation note)."""
+        with self._lock:
+            self._aliases.update(aliases)
 
     def _get_or_create(self, cls, name, help, label_names, **kwargs):
         with self._lock:
@@ -357,9 +369,13 @@ class MetricsRegistry:
             self._metrics.clear()
 
     def render_prometheus(self):
-        """The Prometheus text exposition format (version 0.0.4)."""
+        """The Prometheus text exposition format (version 0.0.4).
+        Aliased families render twice: canonically, then under the
+        deprecated name with a HELP line pointing migrations at the
+        canonical one."""
         with self._lock:
             metrics = sorted(self._metrics.items())
+            aliases = dict(self._aliases)
         lines = []
         for name, m in metrics:
             if m.help:
@@ -367,6 +383,15 @@ class MetricsRegistry:
                     name, m.help.replace("\\", "\\\\").replace("\n", " ")))
             lines.append("# TYPE %s %s" % (name, m.kind))
             m.render(lines)
+        for name, m in metrics:
+            legacy = aliases.get(name)
+            if legacy is None:
+                continue
+            lines.append("# HELP %s DEPRECATED alias of %s; the "
+                         "horovod_* names are removed next release"
+                         % (legacy, name))
+            lines.append("# TYPE %s %s" % (legacy, m.kind))
+            m.render(lines, name=legacy)
         return "\n".join(lines) + "\n"
 
     def snapshot(self):

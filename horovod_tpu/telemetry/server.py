@@ -5,7 +5,9 @@ Four endpoints, served from a daemon ``ThreadingHTTPServer`` that
 when ``HOROVOD_METRICS_PORT`` is configured:
 
 * ``GET /metrics``  — the registry in Prometheus text format,
-* ``GET /healthz``  — liveness JSON (rank identity + step progress),
+* ``GET /healthz``  — liveness JSON (rank identity + step progress);
+  200 while serving, **503** with a ``phase`` field while the rank is
+  parked in an elastic transition (re-rendezvous, checkpoint restore),
 * ``GET /flightrec`` — the flight recorder's current ring as JSON
   (``horovod_tpu.diag``); ``?dump=1`` also writes the on-disk
   ``flightrec.rank<r>.json`` — the on-demand black-box pull,
@@ -115,7 +117,14 @@ class MetricsServer:
                         health = {"status": "ok"}
                         if server._health_fn is not None:
                             health.update(server._health_fn() or {})
-                        self._respond(200, json.dumps(health),
+                        # a rank parked in an elastic transition
+                        # (re-rendezvous, checkpoint restore) is NOT
+                        # healthy-and-serving: 503 with the phase in the
+                        # body, so load balancers and probes drain it
+                        # instead of routing to a wedged rank
+                        code = 200 if health.get("status", "ok") == "ok" \
+                            else 503
+                        self._respond(code, json.dumps(health),
                                       "application/json")
                     elif url.path == "/flightrec":
                         from horovod_tpu.diag import recorder as flightrec

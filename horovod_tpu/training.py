@@ -511,6 +511,14 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
         _wire_holder[0] = None
 
     from horovod_tpu.diag import recorder as _flightrec
+    from horovod_tpu.telemetry import ledger as _ledger_lib
+    # the goodput ledger settles at every step boundary: the interval
+    # since the last settle, minus the stalls other subsystems charged
+    # (data_wait, ckpt_stall, compile, ...), is booked as compute.
+    # Resolved at CALL time (hvd.init opens a fresh run ledger); host-
+    # side floats only — the compiled program is byte-identical with the
+    # ledger on or off (tests/test_goodput.py).
+    _goodput = _ledger_lib.get_ledger
 
     if not tele_on:
         _step_no = [0]
@@ -539,6 +547,7 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                 _wire_holder[0] = None
                 raise
             _flightrec.step_end(n)
+            _goodput().settle_step()
             return new_state, loss
     else:
         from horovod_tpu import basics as _basics
@@ -580,6 +589,7 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                     tl.flow_end("step_dispatch", flow)
                     tl.end_activity("marker")
             _flightrec.step_end(step_no)
+            _goodput().settle_step()
             instruments.record_step(
                 batch=int(inputs.shape[0]),
                 dispatch_s=_time.perf_counter() - t0,
@@ -593,6 +603,7 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     step.reset_error_feedback = _reset_error_feedback
     step.loader = loader
     step.place_data = place_data
+    step._settles_ledger = True  # elastic_train_loop must not re-settle
 
     def lower(state, inputs, labels):
         """AOT lower with the SAME placement the executed path uses, so
@@ -661,6 +672,12 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
     if telemetry_lib.enabled() and not hasattr(train_step, "instruments"):
         own_instruments = telemetry_lib.StepInstruments()
 
+    from horovod_tpu.telemetry import ledger as _ledger_lib
+    # a hand-written train_step doesn't settle the goodput ledger itself
+    # — the loop does it, so its steps still get time attribution
+    _goodput = (None if getattr(train_step, "_settles_ledger", False)
+                else _ledger_lib.get_ledger)
+
     def _batch_of(inputs):
         # hand-written steps may take pytree batches; any leaf's leading
         # dim is the per-call example count (0 when unknowable)
@@ -682,6 +699,8 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
                 inputs, labels = batch_fn(_step_of(state.train_state))
             t0 = _time.perf_counter()
             new_ts, loss = train_step(state.train_state, inputs, labels)
+            if _goodput is not None:
+                _goodput().settle_step()
             if own_instruments is not None:
                 from horovod_tpu import basics as _basics
                 own_instruments.record_step(
@@ -791,6 +810,7 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
         return _placer(mesh, state_specs(state))(state)
 
     from horovod_tpu.diag import recorder as _flightrec
+    from horovod_tpu.telemetry import ledger as _ledger_lib
     _step_no = [0]
 
     def step(state, tokens):
@@ -799,9 +819,11 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
         _flightrec.step_begin(n)
         out = jitted(place_state(state), place_tokens(tokens))
         _flightrec.step_end(n)
+        _ledger_lib.get_ledger().settle_step()
         return out
 
     step.jitted = jitted  # AOT access (lower/compile/cost_analysis)
+    step._settles_ledger = True
 
     def lower(state, tokens):
         """AOT lower with the SAME placement the executed path uses (one

@@ -43,7 +43,10 @@ setup(
         "dev": ["pytest", "cloudpickle"],
     },
     entry_points={
-        "console_scripts": ["hvdrun = horovod_tpu.run.run:main"],
+        "console_scripts": [
+            "hvdrun = horovod_tpu.run.run:main",
+            "hvd-doctor = horovod_tpu.diag.doctor:doctor_cli",
+        ],
     },
     cmdclass={"build_py": BuildWithNativeCore},
 )

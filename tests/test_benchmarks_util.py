@@ -156,3 +156,105 @@ def test_overlap_variants_extend_with_wire_formats():
     assert fmts == ["bf16", "fp8", "int8"]
     with pytest.raises(Exception):
         bench.overlap_variants(["float3"])
+
+
+def test_goodput_block_invariant_validation():
+    """The BENCH `goodput` block contract (ISSUE 9 satellite): the phase
+    sum must explain ~100% of wall time — an unattributed gap >2% (or a
+    double-charged sum above wall) is a loud error, never silence."""
+    from horovod_tpu.telemetry import report as report_mod
+
+    good = {"wall_seconds": 10.0,
+            "phases": {"compute": 9.5, "data_wait": 0.45}}
+    assert report_mod.validate_goodput_block(good) is good
+
+    with pytest.raises(report_mod.GoodputInvariantError,
+                       match="unattributed"):
+        report_mod.validate_goodput_block(
+            {"wall_seconds": 10.0, "phases": {"compute": 9.0}})
+    with pytest.raises(report_mod.GoodputInvariantError,
+                       match="MORE than"):
+        report_mod.validate_goodput_block(
+            {"wall_seconds": 10.0,
+             "phases": {"compute": 9.0, "data_wait": 2.0}})
+    with pytest.raises(report_mod.GoodputInvariantError,
+                       match="no wall time"):
+        report_mod.validate_goodput_block({"wall_seconds": 0.0,
+                                           "phases": {}})
+    # right at the tolerance boundary: 2% unattributed passes
+    report_mod.validate_goodput_block(
+        {"wall_seconds": 10.0, "phases": {"compute": 9.8}})
+
+
+def test_goodput_block_from_live_ledger():
+    """report.goodput_block() finalizes the ledger and the emitted block
+    passes its own validator (what every bench mode attaches)."""
+    from horovod_tpu.telemetry import report as report_mod
+    from horovod_tpu.telemetry.ledger import TimeLedger
+    from horovod_tpu.telemetry.registry import MetricsRegistry
+
+    t = [0.0]
+    led = TimeLedger(clock=lambda: t[0], registry=MetricsRegistry(),
+                     enabled=True)
+    led.start()
+    led.charge("data_wait", 0.4)
+    t[0] = 1.0
+    led.settle_step()
+    t[0] = 1.1
+    block = report_mod.goodput_block(ledger=led)
+    assert block["phases"]["data_wait"] == pytest.approx(0.4)
+    assert block["phases"]["compute"] == pytest.approx(0.6)
+    assert block["wall_seconds"] == pytest.approx(1.1)
+    assert block["unattributed_seconds"] == pytest.approx(0.0)
+    assert block["steps"] == 1
+
+
+def test_bench_attach_goodput_records_violation_loudly(capsys,
+                                                       monkeypatch):
+    """bench._attach_goodput never silently drops the invariant: a
+    violating block yields a goodput_error field + a stderr shout, a
+    healthy ledger yields the block, and HOROVOD_GOODPUT=0 (a
+    documented opt-out) is skipped quietly — no false alarms."""
+    import sys
+
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import bench
+
+    from horovod_tpu.telemetry import ledger as ledger_lib
+    from horovod_tpu.telemetry import report as report_mod
+    from horovod_tpu.telemetry.ledger import TimeLedger
+    from horovod_tpu.telemetry.registry import MetricsRegistry
+
+    old = ledger_lib._ledger
+    try:
+        # healthy: real clock, one settled interval
+        led = TimeLedger(registry=MetricsRegistry(), enabled=True)
+        led.start()
+        time.sleep(0.01)
+        led.settle_step()
+        ledger_lib._ledger = led
+        result = {}
+        bench._attach_goodput(result)
+        assert "goodput" in result and "goodput_error" not in result
+
+        # violating (an unattributed gap a phase hook failed to charge)
+        def broken_block():
+            raise report_mod.GoodputInvariantError("8.0% unattributed")
+
+        monkeypatch.setattr(report_mod, "goodput_block", broken_block)
+        result = {}
+        bench._attach_goodput(result)
+        assert "goodput" not in result
+        assert "unattributed" in result["goodput_error"]
+        assert "GOODPUT INVARIANT VIOLATED" in capsys.readouterr().err
+        monkeypatch.undo()
+
+        # opt-out: disabled ledger -> no block, no error, no shout
+        ledger_lib._ledger = TimeLedger(registry=MetricsRegistry(),
+                                        enabled=False)
+        result = {}
+        bench._attach_goodput(result)
+        assert "goodput" not in result and "goodput_error" not in result
+        assert capsys.readouterr().err == ""
+    finally:
+        ledger_lib._ledger = old

@@ -572,9 +572,19 @@ def test_trace_validity_end_to_end(monkeypatch, tmp_path):
     got = names_of(samples)
     for needed in (instruments.STEP_TOTAL,
                    instruments.EXAMPLES_PER_SEC,
-                   instruments.STALLED_RANKS):
+                   instruments.STALLED_RANKS,
+                   instruments.GOODPUT_RATIO,
+                   instruments.BUILD_INFO):
         assert needed in got, f"scrape missing {needed}"
     assert instruments.STEP_SECONDS + "_count" in got
+    # the goodput ledger's per-phase counters ride every scrape
+    assert (instruments.TIME_SECONDS,
+            frozenset({("phase", '"compute"')})) in samples
+    # renamed families still answer to their horovod_* names (one
+    # release of scrape-time aliases, docs/OBSERVABILITY.md)
+    legacy = instruments.LEGACY_ALIASES[instruments.STEP_TOTAL]
+    assert samples[(legacy, frozenset())] == \
+        samples[(instruments.STEP_TOTAL, frozenset())]
     assert (instruments.COLLECTIVE_BYTES,
             frozenset({("op", '"bucket_rs"')})) in samples
     assert samples[(instruments.STEP_TOTAL, frozenset())] == 3
@@ -664,3 +674,96 @@ def test_instrumentation_overhead_under_2pct(monkeypatch):
             f"(record {record_s * 1e6:.1f} us vs step {step_s * 1e3:.2f} ms)"
     finally:
         hvd_mod.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Metric-name canonicalization: hvd_* catalogue, legacy aliases, and the
+# docs-vs-code drift contract (ISSUE 9 satellites).
+# ---------------------------------------------------------------------------
+
+
+def test_catalogue_is_canonical_hvd_prefixed():
+    """One prefix, no drift: every catalogued name is hvd_*, unique, and
+    every record-helper constant is in the catalogue."""
+    assert len(set(instruments.CATALOGUE)) == len(instruments.CATALOGUE)
+    for name in instruments.CATALOGUE:
+        assert name.startswith("hvd_"), name
+    for canonical, legacy in instruments.LEGACY_ALIASES.items():
+        assert canonical in instruments.CATALOGUE
+        assert legacy.startswith("horovod_")
+        assert legacy.replace("horovod_", "hvd_", 1) == canonical
+
+
+def test_docs_metric_table_matches_catalogue():
+    """The tier-1 drift contract: the metric tables in
+    docs/OBSERVABILITY.md list EXACTLY the names in
+    instruments.CATALOGUE — a metric added (or renamed) in code without
+    a catalogue row fails here, and so does a documented ghost."""
+    import os
+    import re
+
+    doc = os.path.join(os.path.dirname(__file__), os.pardir, "docs",
+                       "OBSERVABILITY.md")
+    with open(doc) as f:
+        text = f.read()
+    documented = set(re.findall(r"^\|\s*`(hvd_[a-z0-9_]+)`\s*\|", text,
+                                flags=re.MULTILINE))
+    catalogued = set(instruments.CATALOGUE)
+    assert documented - catalogued == set(), \
+        f"documented but not registered in instruments.py: " \
+        f"{sorted(documented - catalogued)}"
+    assert catalogued - documented == set(), \
+        f"registered in instruments.py but missing from the " \
+        f"docs/OBSERVABILITY.md catalogue: {sorted(catalogued - documented)}"
+
+
+def test_legacy_aliases_render_on_scrape():
+    """Renamed families are still served under their horovod_* names for
+    one release: same values, a DEPRECATED HELP line, canonical name
+    rendered too. Snapshots stay canonical-only."""
+    r = MetricsRegistry()
+    r.install_aliases({"hvd_step_total": "horovod_step_total",
+                       "hvd_step_latency_seconds":
+                           "horovod_step_latency_seconds"})
+    r.counter("hvd_step_total", "steps").inc(7)
+    r.histogram("hvd_step_latency_seconds").observe(0.5)
+    text = r.render_prometheus()
+    samples = parse_prometheus(text)
+    assert samples[("hvd_step_total", frozenset())] == 7
+    assert samples[("horovod_step_total", frozenset())] == 7
+    assert ("horovod_step_latency_seconds_count", frozenset()) in samples
+    assert "# HELP horovod_step_total DEPRECATED alias of " \
+           "hvd_step_total" in text
+    snap = r.snapshot()
+    assert "hvd_step_total" in snap
+    assert "horovod_step_total" not in snap  # aliases are scrape-only
+
+
+def test_default_registry_serves_legacy_alias_for_live_families():
+    """End to end on the process registry: a catalogued family that
+    exists renders under both names with equal values."""
+    reg = get_registry()
+    reg.counter(instruments.STEP_TOTAL, "steps")  # ensure it exists
+    samples = parse_prometheus(reg.render_prometheus())
+    canonical = samples[(instruments.STEP_TOTAL, frozenset())]
+    legacy_name = instruments.LEGACY_ALIASES[instruments.STEP_TOTAL]
+    assert samples[(legacy_name, frozenset())] == canonical
+
+
+def test_build_info_gauge():
+    """hvd_build_info: constant 1 with the identity as labels (standard
+    Prometheus practice), registered by services when the metrics plane
+    is up and embedded in goodput dumps."""
+    r = MetricsRegistry()
+    instruments.build_info_gauge(registry=r)
+    samples = parse_prometheus(r.render_prometheus())
+    rows = [(k, v) for k, v in samples.items()
+            if k[0] == instruments.BUILD_INFO]
+    assert len(rows) == 1
+    (name, labels), value = rows[0]
+    assert value == 1
+    label_names = {kv[0] for kv in labels}
+    assert label_names == {"version", "jax", "backend", "world"}
+    info = instruments.build_info_labels()
+    assert info["backend"] == "cpu"
+    assert info["jax"] not in ("", "unknown")
