@@ -114,9 +114,9 @@ def lm_tokens_per_sec(flash, *, seq_len=2048, batch=8, layers=12,
         vocab=vocab, flash=flash)
     flops_per_step = 0.0
     try:
-        cost = step.lower(state, tokens).compile().cost_analysis()
-        if cost:
-            flops_per_step = float(cost.get("flops", 0.0))
+        from horovod_tpu.utils.benchmarks import cost_analysis_dict
+        cost = cost_analysis_dict(step.lower(state, tokens).compile())
+        flops_per_step = float(cost.get("flops", 0.0))
     except Exception:
         pass
     for _ in range(warmup):
@@ -335,6 +335,151 @@ def compression_comparison(args):
             if name != "none" and result.get(f"step_ms_{name}"):
                 result[f"speedup_{name}_vs_none"] = round(
                     result["step_ms_none"] / result[f"step_ms_{name}"], 3)
+    result["telemetry"] = _telemetry_block()
+    _attach_goodput(result)
+    print(json.dumps(result))
+
+
+def _record_lm_step_time(args, step, state, tokens, result, suffix):
+    """LM-path timing summary for ``--spmd`` (the LM step takes
+    ``(state, tokens)``): median slope-window step time into
+    ``lm_step_ms_<suffix>`` plus the conservative-bound count — the
+    same discipline as ``_record_step_time``, via the one shared
+    warm-then-measure helper. Unlike the ResNet path — which burns one
+    warmup on the state-materializing step call before its timing — the
+    LM path arrives here cold, so the FULL ``num_warmup`` runs (the
+    slope window's untimed flush would absorb a stray compile either
+    way, but the two paths should enter their windows equally warm)."""
+    from horovod_tpu.utils.benchmarks import repeat_step_windows
+
+    dts, state = repeat_step_windows(
+        lambda st: step(st, tokens), state,
+        args.num_warmup, args.num_iters, args.repeats)
+    ordered = sorted(float(d) for d in dts)
+    result[f"lm_step_ms_{suffix}"] = round(
+        1000 * ordered[len(ordered) // 2] / args.num_iters, 2)
+    n_bound = sum(1 for d in dts if getattr(d, "upper_bound", False))
+    if n_bound:
+        result[f"lm_upper_bound_windows_{suffix}"] = n_bound
+    return state
+
+
+def spmd_comparison(args):
+    """``--spmd``: the GSPMD-vs-explicit head-to-head (ROADMAP open item
+    1; docs/PERFORMANCE.md, "The GSPMD path") on BOTH hot paths:
+
+    * **ResNet**: explicit overlap+ZeRO-1 pipeline vs the
+      NamedSharding-compiled GSPMD step (``make_train_step(spmd=True)``
+      — no explicit collective calls, XLA inserts the exchange) vs
+      GSPMD-with-wire-compression (which takes the documented fallback
+      through the explicit bucketed pipeline — the compressed exchange
+      has no annotation-only form).
+    * **LM**: the shared ``make_lm_bench`` workload, batch-sharded over
+      the full data mesh — GSPMD and its wire-fallback vs the
+      ``explicit`` LM step. The LM path has no overlap+ZeRO pipeline
+      (``make_lm_train_step`` reduces via one fused allreduce), so its
+      baseline is the explicit fused-AR step and its keys say
+      ``lm_step_ms_explicit`` — deliberately NOT the ResNet half's
+      ``explicit_overlap_zero1`` label.
+
+    Emits per-variant step times, measured per-device optimizer-state
+    bytes (the ZeRO-1 sharding must survive the path change), the
+    compiled-HLO collective byte accounting for the GSPMD builds, and
+    the parity ratios the acceptance gate reads
+    (``gspmd_over_explicit_step_time`` <= 1.02 before GSPMD can become
+    a default). One JSON line, same contract as the headline bench."""
+    import warnings
+
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import training
+    from horovod_tpu.utils.benchmarks import (make_lm_bench, make_model,
+                                              synthetic_batch)
+
+    hvd.init()
+    ndev = hvd.num_devices()
+    global_batch = args.batch_size * ndev
+    images, labels = synthetic_batch(global_batch, args.image_size)
+
+    result = {"metric": f"{args.model}_gspmd_vs_explicit_step_ms",
+              "unit": "ms/step", "devices": ndev,
+              "per_chip_batch": args.batch_size, "repeats": args.repeats,
+              "spmd_wire": args.spmd_wire}
+
+    variants = {
+        "explicit_overlap_zero1": dict(spmd=False, wire=None),
+        "gspmd": dict(spmd=True, wire=None),
+        f"gspmd_wire_{args.spmd_wire}": dict(spmd=True,
+                                             wire=args.spmd_wire),
+    }
+    for name, kind in variants.items():
+        model = make_model(args.model)
+        tx = hvd.DistributedOptimizer(optax.adamw(1e-3),
+                                      sharded_update=True,
+                                      compression=kind["wire"])
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            step = training.make_train_step(
+                model, tx, donate=True, spmd=kind["spmd"],
+                overlap_grads=not kind["spmd"])
+        for w in caught:
+            if "falling back" in str(w.message):
+                result[f"note_{name}"] = "bucketed_fallback"
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0),
+                                            images[:1])
+        state, _ = step(state, images, labels)
+        result[f"opt_state_bytes_per_device_{name}"] = (
+            _opt_state_bytes_per_device(state.opt_state))
+        if getattr(step, "compiled_collectives", None):
+            result[f"compiled_collective_bytes_{name}"] = {
+                op: t["bytes"]
+                for op, t in step.compiled_collectives.items()}
+        _record_step_time(args, step, state, images, labels, result, name)
+
+    # -- LM path (the shared make_lm_bench workload, data-sharded) -----
+    lm_cfg = dict(batch=2 * ndev, seq_len=args.spmd_lm_seq_len,
+                  layers=2, d_model=args.spmd_lm_d_model, heads=8,
+                  vocab=2048)
+    result["lm_config"] = lm_cfg
+    # the LM baseline is the explicit fused-allreduce step — there is
+    # no overlap+ZeRO LM pipeline to compare against, and labeling it
+    # as one would publish a parity ratio against a baseline that is
+    # not the named thing
+    lm_variants = {
+        "explicit": dict(spmd=False, wire=None),
+        "gspmd": dict(spmd=True, wire=None),
+        f"gspmd_wire_{args.spmd_wire}": dict(spmd=True,
+                                             wire=args.spmd_wire),
+    }
+    for name, kind in lm_variants.items():
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            step, state, tokens = make_lm_bench(
+                mesh=hvd.mesh(), seq_axis=None, flash=None,
+                spmd=kind["spmd"], compression=kind["wire"], **lm_cfg)
+        for w in caught:
+            if "falling back" in str(w.message):
+                result[f"lm_note_{name}"] = "explicit_fallback"
+        state = _record_lm_step_time(args, step, state, tokens, result,
+                                     name)
+        if getattr(step, "compiled_collectives", None):
+            result[f"lm_compiled_collective_bytes_{name}"] = {
+                op: t["bytes"]
+                for op, t in step.compiled_collectives.items()}
+
+    for prefix, base_name, key in (
+            ("step_ms", "explicit_overlap_zero1",
+             "gspmd_over_explicit_step_time"),
+            ("lm_step_ms", "explicit",
+             "lm_gspmd_over_explicit_step_time")):
+        base = result.get(f"{prefix}_{base_name}")
+        got = result.get(f"{prefix}_gspmd")
+        if base and got:
+            result[key] = round(got / base, 3)
+            result[key + "_parity_within_2pct"] = bool(
+                got / base <= 1.02)
     result["telemetry"] = _telemetry_block()
     _attach_goodput(result)
     print(json.dumps(result))
@@ -611,6 +756,22 @@ def main():
                              "matrix with overlap+ZeRO-1 variants at "
                              "each wire format — the full pipeline in "
                              "one run")
+    parser.add_argument("--spmd", action="store_true",
+                        help="run ONLY the GSPMD-vs-explicit comparison: "
+                             "explicit overlap+ZeRO-1 vs the NamedSharding-"
+                             "compiled GSPMD step vs GSPMD+wire (bucketed "
+                             "fallback), on the ResNet AND LM paths "
+                             "(docs/PERFORMANCE.md, 'The GSPMD path')")
+    parser.add_argument("--spmd-wire", default="int8",
+                        metavar="{bf16,fp8,int8}",
+                        help="wire format for the --spmd compressed "
+                             "variant (default int8)")
+    parser.add_argument("--spmd-lm-d-model", type=int, default=256,
+                        help="--spmd LM-path model width (small default "
+                             "so the comparison runs on CPU meshes; "
+                             "raise on real chips)")
+    parser.add_argument("--spmd-lm-seq-len", type=int, default=256,
+                        help="--spmd LM-path sequence length")
     parser.add_argument("--data-plane", action="store_true",
                         help="run ONLY the input-bound data-plane "
                              "comparison: the same step fed "
@@ -631,6 +792,15 @@ def main():
     if args.data_plane and (args.overlap or args.compression is not None):
         parser.error("--data-plane is its own comparison mode; run it "
                      "separately from --overlap/--compression")
+    if args.spmd and (args.overlap or args.compression is not None
+                      or args.data_plane):
+        parser.error("--spmd is its own comparison mode; run it "
+                     "separately from --overlap/--compression/"
+                     "--data-plane")
+
+    if args.spmd:
+        spmd_comparison(args)
+        return
 
     if args.data_plane:
         data_plane_comparison(args)
@@ -679,9 +849,10 @@ def main():
     try:
         # step.lower places args exactly like the timed path: same cache
         # key, so this is THE compile the loop reuses, not an extra one
-        cost = step.lower(state, images, labels).compile().cost_analysis()
-        if cost:
-            flops_per_device_step = float(cost.get("flops", 0.0))
+        from horovod_tpu.utils.benchmarks import cost_analysis_dict
+        cost = cost_analysis_dict(
+            step.lower(state, images, labels).compile())
+        flops_per_device_step = float(cost.get("flops", 0.0))
     except Exception:
         pass
 
@@ -693,9 +864,14 @@ def main():
     autotuned_mb = None
     autotune_error = None
     autotune_abstained = None
+    autotune_escalations = None
     try:
         best_thr, at_timings = hvd.autotune_fusion_threshold(
             state.params, trials=5, apply=False)
+        # measured-vs-guessed provenance: nonzero means some trials sat
+        # at the noise floor and needed 4x iter escalation (a threshold
+        # that stayed an upper bound after escalation abstains instead)
+        autotune_escalations = at_timings.slope_window_escalations
         if best_thr is None:
             # abstention contract (docs/AUTOTUNE.md): no rankable signal
             # -> record null + the reason, never a noise argmin
@@ -798,6 +974,8 @@ def main():
                    flash=True, batch=8, seq_parallel=True)
 
     result["autotuned_fusion_threshold_mb"] = autotuned_mb
+    if autotune_escalations is not None:
+        result["autotune_slope_window_escalations"] = autotune_escalations
     if autotune_abstained is not None:
         result["autotune_abstained"] = autotune_abstained
     if autotune_error is not None:

@@ -50,7 +50,13 @@ logger = logging.getLogger("horovod_tpu")
 
 MANIFEST_NAME = "MANIFEST.json"
 LATEST_NAME = "latest"
-FORMAT_VERSION = 1
+# 1: one unkeyed ZeroState row per rank shard (the pre-GSPMD layout).
+# 2: ZeroState rows keyed by ROW index, each shard carrying the block
+#    of schedule rows its process owns (sharded.py _owned_rows) — a
+#    single GSPMD process saves every row. Readers accept <= their own
+#    version (v2 restores v1 shards); a payload from a NEWER writer
+#    fails loudly by version, not by a misleading shape error.
+FORMAT_VERSION = 2
 
 _DIR_RE = re.compile(r"^ckpt-(\d+)$")
 _POLL_S = 0.02

@@ -7,10 +7,17 @@
   leaf ``i`` lives in shard ``i % world`` — so write bandwidth scales
   with the world and no byte is written twice;
 * inside each ``ZeroState``, the ``[world, shard]`` bucket-row leaves
-  are split by OWNERSHIP: rank ``r`` writes exactly row ``r`` (its own
-  optimizer shard — the bytes it already holds under ZeRO-1, never
-  re-gathered); the small replicated inner leaves (step counts etc.)
-  ride in rank 0's shard.
+  are split by OWNERSHIP: each rank writes its contiguous block of the
+  schedule's rows (``_owned_rows`` — the bytes it already holds under
+  ZeRO-1, never re-gathered). One process per mesh slot means exactly
+  row ``r`` for rank ``r`` — the original layout; a single process
+  driving a multi-device mesh (the GSPMD hot path,
+  ``parallel/gspmd.py``, where the rows live ``P('data')``-sharded as
+  one ``NamedSharding`` array) owns and writes ALL of them. Shards
+  store rows keyed by row index; the restore side also accepts the
+  pre-GSPMD single-row layout, so old checkpoints keep loading. The
+  small replicated inner leaves (step counts etc.) ride in rank 0's
+  shard.
 
 **Why N→M reshard is deterministic.** The flat bucket partition
 (``ops/fusion.plan_buckets``) depends only on the parameter leaves and
@@ -114,6 +121,19 @@ def _bucket_index(path, leaf, sched):
     return None
 
 
+def _owned_rows(sched_world, rank, world):
+    """The contiguous block of a schedule's ``[world, shard]`` rows that
+    process ``rank`` of ``world`` saves: the block partition of
+    ``sched_world`` rows across ``world`` processes. One process per
+    mesh slot (``world == sched_world``) degenerates to ``[rank]`` —
+    the original one-row-per-rank layout; one process driving the whole
+    mesh (``world == 1``) owns every row. Matches physical placement
+    for process-contiguous meshes, so each row read stays local."""
+    lo = rank * sched_world // world
+    hi = (rank + 1) * sched_world // world
+    return range(lo, hi)
+
+
 def _inner_entries(zstate):
     """``(key, bucket_index_or_None, leaf)`` per inner leaf of a
     ZeroState: the key is the stable tree-path string."""
@@ -157,10 +177,16 @@ def snapshot_payload(tree, rank, world):
     z = 0
     for i, leaf in enumerate(leaves):
         if _is_zero_state(leaf):
+            sched_world = int(leaf.plan.schedule.world)
+            own = _owned_rows(sched_world, rank, world)
             rows, zrepl = {}, {}
             for key, bucket, inner_leaf in _inner_entries(leaf):
                 if bucket is not None:
-                    rows[key] = _row(inner_leaf, rank)
+                    # rows keyed by ROW index (not process rank): the
+                    # schedule's world and the process world need not
+                    # match — a single GSPMD process owns every row
+                    rows[key] = {str(r): _row(inner_leaf, r)
+                                 for r in own}
                 elif rank == 0:
                     zrepl[key] = _host(inner_leaf)
             zeros[str(z)] = {"rows": rows, "repl": zrepl}
@@ -222,7 +248,16 @@ def _read_shard(root, step, rank, world, expect):
             f"checkpoint shard {path} failed its CRC32 check "
             f"(manifest {expect['crc32']:#010x}, file {crc:#010x}) — "
             "the shard is corrupt or torn; restore a different step")
-    return serialization.msgpack_restore(data)
+    payload = serialization.msgpack_restore(data)
+    fmt = int(payload.get("format", 1))
+    if fmt > manifest_lib.FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint shard {path} was written with format {fmt}, "
+            f"this reader understands <= {manifest_lib.FORMAT_VERSION}: "
+            "the checkpoint comes from a NEWER horovod_tpu — upgrade "
+            "this process (or restore an older checkpoint) instead of "
+            "letting a layout mismatch surface as a shape error")
+    return payload
 
 
 def _assemble_zero(target_z, z, payloads, info):
@@ -261,14 +296,29 @@ def _assemble_zero(target_z, z, payloads, info):
                         f"checkpoint, the restore target expects "
                         f"{np.shape(leaf)}")
             return saved
-        try:
-            rows = [payloads[r]["zero"][zkey]["rows"][key]
-                    for r in range(src_world)]
-        except KeyError:
+        rows_by_idx = {}
+        for p in payloads:
+            entry = p.get("zero", {}).get(zkey, {}).get("rows", {})
+            saved_rows = entry.get(key)
+            if saved_rows is None:
+                continue
+            if isinstance(saved_rows, dict):
+                # current layout: rows keyed by row index (each payload
+                # holds the block its process owned at save time)
+                for rk, arr in saved_rows.items():
+                    rows_by_idx[int(rk)] = arr
+            else:
+                # pre-GSPMD layout: one unkeyed row per shard — its row
+                # index IS the saving process's rank
+                rows_by_idx[int(p["rank"])] = saved_rows
+        missing = [r for r in range(src_world) if r not in rows_by_idx]
+        if missing:
             raise ValueError(
-                f"checkpoint is missing bucket row {key!r} of "
-                f"ZeroState #{z} for some source rank") from None
-        flat = np.concatenate([np.asarray(r).reshape(-1) for r in rows])
+                f"checkpoint is missing bucket row(s) {missing} of "
+                f"{key!r} in ZeroState #{z} (saved schedule world "
+                f"{src_world})")
+        flat = np.concatenate([np.asarray(rows_by_idx[r]).reshape(-1)
+                               for r in range(src_world)])
         n_used = used[bucket]
         if flat.shape[0] < n_used:
             raise ValueError(

@@ -72,6 +72,35 @@ if not hasattr(jax.lax, "axis_size"):  # jax < 0.4.38
     jax.lax.axis_size = _axis_size
 
 
+def gspmd_supported():
+    """``(ok, reason)`` — whether this jax can run the GSPMD hot path
+    (``training.make_train_step(spmd=True)`` / ``parallel/gspmd.py``):
+    ``NamedSharding``, ``with_sharding_constraint`` and a ``jax.jit``
+    that takes ``in_shardings``/``out_shardings``/``donate_argnums``.
+    jax 0.4.x ships all three; genuinely older runtimes keep the
+    explicit shard_map pipeline and get the reason string in the error.
+    """
+    import inspect
+
+    try:
+        from jax.sharding import NamedSharding  # noqa: F401
+    except ImportError:
+        return False, ("jax.sharding.NamedSharding is unavailable — "
+                       "this jax predates the GSPMD sharding API")
+    if not hasattr(jax.lax, "with_sharding_constraint"):
+        return False, ("jax.lax.with_sharding_constraint is unavailable "
+                       "— this jax cannot annotate in-program shardings")
+    try:
+        params = inspect.signature(jax.jit).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        return False, "jax.jit signature cannot be introspected"
+    for kw in ("in_shardings", "out_shardings", "donate_argnums"):
+        if kw not in params:
+            return False, (f"jax.jit lacks {kw}= — this jax cannot "
+                           "compile NamedSharding-annotated steps")
+    return True, None
+
+
 def bound_axis_names():
     """Mesh axis names bound in the current trace (inside ``shard_map`` /
     any named-axis context); ``()`` at top level. Works on both the

@@ -138,6 +138,7 @@ class PrefetchLoader:
         self._drop_last = bool(drop_last)
         self._epochs = None if epochs is None else int(epochs)
         self._placement = placement
+        self.placement_spec = None
         self._epoch = 0
         self._offset = 0
         self._batch_index = 0
@@ -411,17 +412,29 @@ class PrefetchLoader:
         self._exhausted = False
 
     # -- placement ----------------------------------------------------------
-    def attach_placement(self, placement):
+    def attach_placement(self, placement, spec=None):
         """Install (or replace) the producer-side staging function.
         ``training.make_train_step(loader=...)`` calls this with its
-        own mesh ``device_put`` so batches land pre-sharded. Replacing
-        the placement restarts the producer from the consumer cursor —
+        own mesh ``device_put`` so batches land pre-sharded — on the
+        GSPMD path that is a ``NamedSharding`` put straight onto the
+        plan's batch sharding (``parallel/gspmd.py``), so prefetched
+        batches arrive already laid out for the compiled step's
+        ``in_shardings``. ``spec`` optionally names WHAT the staging
+        targets (a ``PartitionSpec``/``NamedSharding``), exposed as
+        ``placement_spec`` for diagnostics — the batch layout is
+        otherwise opaque inside the callable. Replacing the placement
+        restarts the producer from the consumer cursor —
         already-queued batches were staged the old way and are
         discarded, never delivered."""
         if placement is self._placement:
+            # no-op re-attach: keep the recorded spec unless the caller
+            # supplied a fresh one (a default None must not clobber it)
+            if spec is not None:
+                self.placement_spec = spec
             return
         self._halt_producer()
         self._placement = placement
+        self.placement_spec = spec
 
     def close(self):
         self._halt_producer()

@@ -208,6 +208,31 @@ class HorovodOptimizer:
                                                        params)
         return inner_updates, (state[0], inner_state)
 
+    def update_spmd(self, grads, state, params, plan):
+        """The GSPMD-path update (``training.make_train_step(spmd=True)``
+        routes here): gradients arrive as the logical GLOBAL-batch mean —
+        XLA's inserted collectives already own the reduction — so no
+        allreduce is chained. ZeRO-1 state goes through the plan's
+        sharding-constraint exchange (``parallel/gspmd.apply_shards_spmd``,
+        no explicit collective calls); plain state through the inner
+        transform with the chain structure preserved, so optimizer state
+        and checkpoints stay interchangeable with the explicit path.
+        Same public ``DistributedOptimizer`` surface — this method is the
+        routing, not a new user contract."""
+        if self.sharded_update:
+            from horovod_tpu.parallel import gspmd
+            if params is None:
+                raise ValueError("sharded_update needs params: "
+                                 "tx.update_spmd(grads, state, params, plan)")
+            return gspmd.apply_shards_spmd(self.inner, grads, state,
+                                           params, plan)
+        if self.backward_passes_per_step > 1:
+            raise ValueError(
+                "backward_passes_per_step>1 has no GSPMD path — its "
+                "accumulator lives in the explicit pipeline; use "
+                "make_train_step(accum_steps=...) there")
+        return self.update_preaveraged(grads, state, params)
+
     def _hierarchical_resolved(self):
         if self.hierarchical is not None:
             return self.hierarchical

@@ -524,15 +524,22 @@ def fused_allreduce(tree, op=collective.Average, axes=None,
 class AutotuneTimings(dict):
     """``{threshold_bytes: seconds}`` from :func:`autotune_fusion_threshold`
     plus ``retried`` — how many candidate trials hit an inverted slope
-    window and were re-measured with doubled iters (a nonzero count means
-    the trial lengths were near the noise floor for this workload) — and
-    ``abstain_reason``: when the tuner returned ``(None, timings)``
-    instead of a winner, the human-readable reason why the trials carried
-    no rankable signal (docs/AUTOTUNE.md, "When the tuner abstains")."""
+    window and entered the escalation loop (a nonzero count means the
+    trial lengths were near the noise floor for this workload) —
+    ``slope_window_escalations`` — how many 4x iter escalations those
+    retries burned in total (0 with every trial cleanly measured; the
+    BENCH json records it so a threshold that was MEASURED is
+    distinguishable from one that was still a guessed upper bound after
+    escalation) — and ``abstain_reason``: when the tuner returned
+    ``(None, timings)`` instead of a winner, the human-readable reason
+    why the trials carried no rankable signal (docs/AUTOTUNE.md, "When
+    the tuner abstains")."""
 
-    def __init__(self, *args, retried=0, abstain_reason=None, **kwargs):
+    def __init__(self, *args, retried=0, slope_window_escalations=0,
+                 abstain_reason=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.retried = retried
+        self.slope_window_escalations = slope_window_escalations
         self.abstain_reason = abstain_reason
 
 
@@ -688,13 +695,18 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
         dt, st = slope_window(step_once, st, trials)
         # Inverted slope window: the trial produced a full-window UPPER
         # BOUND (fixed dispatch costs included), not a measurement —
-        # ranking candidates on it compares noise. Retry with doubled
-        # iters until the slope carries signal (cap at 8x).
+        # ranking candidates on it compares noise. The BENCH_r05 noise
+        # source was exactly this tail: doubling crept up too slowly to
+        # clear the fixed-cost floor within its cap, so bounds leaked
+        # into the ranking. Escalate HARD instead — x4 per retry,
+        # bounded at 16x — and count every escalation so the BENCH json
+        # can tell a measured threshold from a guessed bound.
         iters = trials
         if dt.upper_bound:
             timings.retried += 1
-            while dt.upper_bound and iters < trials * 8:
-                iters *= 2
+            while dt.upper_bound and iters < trials * 16:
+                iters *= 4
+                timings.slope_window_escalations += 1
                 dt, st = slope_window(step_once, st, iters)
         # normalize retried trials back to seconds-per-`trials`-iters so
         # candidates stay comparable under argmin
@@ -720,7 +732,8 @@ def autotune_fusion_threshold(tree, op=collective.Average, axes=None,
         timings = AutotuneTimings(
             {c: WindowTime(float(s), upper_bound=bool(b > 0))
              for c, s, b in zip(keys, summed, summed[len(keys):])},
-            retried=timings.retried)
+            retried=timings.retried,
+            slope_window_escalations=timings.slope_window_escalations)
 
     def _fmt_key(c):
         if joint:

@@ -1,0 +1,321 @@
+"""GSPMD hot path: one logical mesh, NamedSharding-compiled collectives.
+
+The explicit pipeline (``ops/fusion.py`` + ``training.make_train_step``
+with ``overlap_grads=True``) hand-dispatches one reduce-scatter per
+bucket and one all-gather per bucket, in an order the builder chose.
+That mirrors reference Horovod's fusion buffer — which exists only
+because the frameworks it wraps cannot schedule collectives themselves
+(PAPER.md, layer map). XLA can: annotate the state with
+:class:`~jax.sharding.NamedSharding` on ONE logical mesh, ``jax.jit``
+the whole step, and the SPMD partitioner inserts, fuses and — with the
+latency-hiding scheduler flags ``config.xla_overlap_flags`` already
+installs — overlaps every collective the shardings imply. The pattern
+scales "from 8-chip v4 to 6000-chip v5p without changing application
+code" (SNIPPETS.md [2]/[3]).
+
+This module is the plan layer for that path:
+
+* :class:`GspmdPlan` — derives the logical mesh + axes from
+  ``parallel/mesh.py``; batches shard over its data axes, params stay
+  replicated, and ZeRO-1 optimizer rows shard over their SCHEDULE's
+  scatter axes (``state_partition_specs`` → ``zero.state_specs``) on
+  dim 0 of the same ``[world, shard]`` bucket layout the explicit path
+  uses — so checkpoints are interchangeable between the two paths, bit
+  for bit.
+* :func:`apply_shards_spmd` — the ZeRO-1 exchange with **no explicit
+  collective calls**: gradients are packed into the schedule's bucket
+  rows and constrained to the row sharding (XLA inserts the
+  reduce-scatter), the inner optimizer updates only the local rows, and
+  the unpacked updates are constrained back to replicated (XLA inserts
+  the all-gather).
+* :func:`collective_bytes_from_hlo` / :func:`record_compiled_collectives`
+  — byte accounting for the compiled path. There are no per-dispatch
+  counters to advance (nothing in Python dispatches a collective), so
+  the wire volume is read off the compiled HLO module itself and
+  recorded under the standard ``hvd_collective_*`` families with
+  ``spmd_*`` op labels.
+
+``training.make_train_step(spmd=True)`` is the consumer;
+``hvd.DistributedOptimizer`` stays the user-facing veneer
+(``hvd_jax.HorovodOptimizer.update_spmd`` routes here). Version gating
+lives in ``compat.gspmd_supported`` — jax builds without
+``NamedSharding``-aware ``jit`` keep the explicit pipeline.
+"""
+
+import dataclasses
+import re
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class GspmdPlan:
+    """Static description of the GSPMD hot path's logical mesh: which
+    axes batches (and ZeRO rows) shard over, and which axis — if any —
+    tensor-parallel layers may shard model weights over. Hashable, so a
+    plan can key jit caches and ride as static data."""
+
+    mesh: jax.sharding.Mesh
+    data_axes: tuple
+    model_axis: str = None
+
+    @property
+    def batch_spec(self):
+        """Leading (batch) dim sharded over every data axis. ZeRO-1 row
+        specs are NOT a plan property: a row's scatter axes belong to
+        its ``ZeroState``'s schedule (``zero.state_specs`` /
+        ``state_partition_specs`` below — an optimizer built with
+        explicit ``axes=`` may scatter over a subset of the mesh), so
+        :func:`apply_shards_spmd` derives them from the schedule it is
+        handed rather than publishing a plan-level spec that could
+        disagree with it."""
+        return P(self.data_axes)
+
+    def sharding(self, spec):
+        return jax.sharding.NamedSharding(self.mesh, spec)
+
+    def world(self):
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return int(np.prod([shape[a] for a in self.data_axes]))
+
+
+def derive_plan(mesh=None, model_axis=None):
+    """Build the :class:`GspmdPlan` for ``mesh`` (default: the mesh
+    ``horovod_tpu.init()`` installed). Data axes come from
+    ``mesh_lib.data_axis_names`` — ``data`` plus ``dcn`` when present —
+    exactly the axes the explicit path reduces gradients over, so the
+    two paths shard the same state the same way. ``model_axis`` names an
+    extra mesh axis for tensor-parallel composition (validated to exist;
+    the DP-only step leaves params replicated over it)."""
+    mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+    data_axes = mesh_lib.data_axis_names(mesh)
+    if not data_axes:
+        raise ValueError(
+            f"mesh {mesh.axis_names!r} has no data/dcn axis to shard "
+            "batches over; build it with parallel.mesh.build_mesh")
+    if model_axis is not None and model_axis not in mesh.axis_names:
+        raise ValueError(
+            f"model_axis {model_axis!r} is not an axis of the mesh "
+            f"{mesh.axis_names!r}")
+    return GspmdPlan(mesh=mesh, data_axes=tuple(data_axes),
+                     model_axis=model_axis)
+
+
+def state_partition_specs(state):
+    """PartitionSpecs for a training-state pytree: everything replicated
+    except ``ZeroState`` bucket rows, which shard over their schedule's
+    scatter axes (``zero.state_specs``). The ONE spec authority for both
+    hot paths — ``training.state_specs`` delegates here, so the explicit
+    shard_map step, the GSPMD jit step, placement and checkpointing all
+    agree on which leaf lives where."""
+    from horovod_tpu.parallel import zero as zero_lib
+
+    def one(node):
+        if isinstance(node, zero_lib.ZeroState):
+            return zero_lib.state_specs(node)
+        return jax.tree_util.tree_map(lambda _: P(), node)
+
+    return jax.tree_util.tree_map(
+        one, state, is_leaf=lambda x: isinstance(x, zero_lib.ZeroState))
+
+
+def _is_spec(x):
+    return isinstance(x, P)
+
+
+def state_shardings(plan, state):
+    """``NamedSharding`` tree matching ``state``'s structure — feed
+    straight to ``jax.jit(in_shardings=...)`` / ``out_shardings``."""
+    return jax.tree_util.tree_map(plan.sharding,
+                                  state_partition_specs(state),
+                                  is_leaf=_is_spec)
+
+
+def place_state(plan, state):
+    """``device_put`` ``state`` onto its plan shardings (no-op when
+    already placed) — the GSPMD analogue of the explicit path's
+    ``place_state``, and what a checkpoint restore feeds its
+    host-assembled tree through before stepping."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), state,
+        state_shardings(plan, state))
+
+
+def constrain(x, plan, spec):
+    """``with_sharding_constraint`` against the plan's mesh — the only
+    way this path ever asks for communication: the constraint states
+    where the value must live, XLA decides how it gets there."""
+    return jax.lax.with_sharding_constraint(x, plan.sharding(spec))
+
+
+def apply_shards_spmd(tx, grads, zstate, params, plan):
+    """ZeRO-1 under GSPMD: the sharding-annotation replacement for
+    ``zero.sharded_update`` — identical ``[world, shard]`` bucket-row
+    layout and identical inner-optimizer math, but **zero explicit
+    collective calls**:
+
+    1. pack the (logically global-mean) gradient into each bucket's
+       padded rows and constrain them to ``schedule.axes`` on dim 0 —
+       the partitioner turns the pending gradient reduction plus this
+       sharded consumer into a reduce-scatter (or an all-reduce it then
+       slices; either way the annotation, not this code, owns the
+       choice and the latency-hiding scheduler owns the overlap);
+    2. run ``tx.update`` on the row pytree — each device touches only
+       its own rows, the ~1/N optimizer compute and state of ZeRO-1;
+    3. constrain the updated rows replicated and unpack — the implied
+       all-gather of the parameter deltas.
+
+    Returns ``(updates, new_zstate)`` with ``updates`` shaped like
+    ``params``. The inner state structure matches the explicit path's
+    exactly, so checkpoints restore across paths unchanged."""
+    from horovod_tpu.ops import fusion
+    from horovod_tpu.parallel import zero as zero_lib
+
+    schedule = zstate.plan.schedule
+    row_spec = P(tuple(schedule.axes))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    grad_leaves = jax.tree_util.tree_leaves(grads)
+    if len(grad_leaves) != len(leaves):
+        raise ValueError(
+            f"gradient tree has {len(grad_leaves)} leaves, params have "
+            f"{len(leaves)}; was the optimizer initialized with a "
+            "different parameter tree?")
+    grad_rows, param_rows = {}, {}
+    for i in range(len(schedule.buckets)):
+        grad_rows[f"b{i}"] = constrain(
+            zero_lib.bucket_rows(schedule, i, grad_leaves), plan, row_spec)
+        param_rows[f"b{i}"] = constrain(
+            zero_lib.bucket_rows(schedule, i, leaves), plan, row_spec)
+    update_rows, new_inner = tx.update(grad_rows, zstate.inner, param_rows)
+
+    new_leaves = [None] * len(leaves)
+    for i in range(len(schedule.buckets)):
+        rows = constrain(update_rows[f"b{i}"], plan, row_spec)
+        flat = constrain(rows.reshape(-1), plan, P())
+        for j, arr in fusion.unpack_bucket(schedule, i, flat,
+                                           leaves).items():
+            new_leaves[j] = arr
+    missing = [j for j, leaf in enumerate(new_leaves) if leaf is None]
+    if missing:
+        raise ValueError(
+            f"ZeRO plan does not cover gradient leaves {missing}; was "
+            "the optimizer initialized with a different parameter tree?")
+    updates = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return updates, zero_lib.ZeroState(new_inner, zstate.plan)
+
+
+# -- compiled-HLO byte accounting -------------------------------------------
+
+# `%name = f32[128,256]{1,0} all-reduce(...)` — result dtype/shape, then
+# the collective op. Two wrinkles:
+#
+# * With the latency-hiding scheduler (the exact configuration this
+#   path targets on TPU — config.xla_overlap_flags), collectives lower
+#   to async `all-reduce-start`/`all-reduce-done` PAIRS instead of the
+#   sync form. The `-start` carries the op (counted, attributed to the
+#   base op name); the `-done` is the completion handle (skipped — the
+#   regexes require `(` right after the optional `-start`, so `-done(`
+#   never matches). CPU emits only sync forms, which is why a
+#   CPU-only check cannot stand in for this.
+# * Variadic/async collectives produce a TUPLE result. For variadic
+#   sync ops every tuple element is an output (sum them); an async
+#   `-start` tuple is (inputs..., outputs...) — symmetric halves, k
+#   aliased inputs then k outputs (the combiner passes fuse many
+#   gradient tensors into one variadic collective) — so sum only the
+#   OUTPUT half; counting the input aliases too would double the
+#   bytes.
+_HLO_RESULT_RE = re.compile(
+    r"=\s*([a-z][a-z0-9]*)\[([0-9,]*)\][^=]*?"
+    r"\b(all-reduce|reduce-scatter|all-gather|all-to-all|"
+    r"collective-permute)(-start)?\(")
+_HLO_TUPLE_RE = re.compile(
+    r"=\s*\(.*?\)\s*"
+    r"(all-reduce|reduce-scatter|all-gather|all-to-all|"
+    r"collective-permute)(-start)?\(")
+_HLO_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+_HLO_ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+
+def _shape_bytes(dtype, dims):
+    itemsize = _HLO_ITEMSIZE.get(dtype)
+    if itemsize is None:
+        return 0
+    n = 1
+    for d in dims.split(","):
+        d = d.strip()
+        if d:
+            n *= int(d)
+    return n * itemsize
+
+
+def collective_bytes_from_hlo(hlo_text):
+    """Per-op collective byte/call totals of one compiled module, parsed
+    from its optimized HLO text: ``{op: {"calls": n, "bytes": b}}``
+    where ``bytes`` is the per-device result payload of every
+    instruction of that op. This is the compiled path's replacement for
+    the explicit pipeline's per-dispatch counters — the module IS the
+    schedule, so the module is what gets accounted."""
+    out = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_RESULT_RE.search(line)
+        if m:
+            dtype, dims, op = m.group(1), m.group(2), m.group(3)
+            nbytes = _shape_bytes(dtype, dims)
+        else:
+            t = _HLO_TUPLE_RE.search(line)
+            if not t:
+                continue
+            op = t.group(1)
+            head = line[:t.end(1)]
+            shapes = _HLO_SHAPE_RE.findall(head)
+            if t.group(2):
+                # async -start: (inputs..., outputs...) — keep the
+                # output half. collective-permute-start additionally
+                # carries trailing rank-0 unsigned context handles
+                # (u32[] tokens): strip those first, or the "half"
+                # would land on them and count ~0 payload. An
+                # unexpectedly odd tuple degrades to the final element
+                # rather than over-counting.
+                while (len(shapes) > 2 and shapes[-1][1] == ""
+                       and shapes[-1][0] in ("u32", "s32", "u64",
+                                             "s64")):
+                    shapes = shapes[:-1]
+                half = len(shapes) // 2
+                shapes = (shapes[half:] if half and not len(shapes) % 2
+                          else shapes[-1:])
+            nbytes = sum(_shape_bytes(d, dims) for d, dims in shapes)
+        slot = out.setdefault(op, {"calls": 0, "bytes": 0})
+        slot["calls"] += 1
+        slot["bytes"] += nbytes
+    return out
+
+
+def record_compiled_collectives(compiled, prefix="spmd"):
+    """Account one compiled step's collectives into the standard
+    telemetry families (``hvd_collective_{calls,bytes,logical_bytes}
+    _total`` under ``<prefix>_<op>`` labels). Analogous to the explicit
+    path's trace-time counters: recorded ONCE per compile, describing
+    the collectives baked into the program — multiply by step count for
+    cumulative volume (docs/OBSERVABILITY.md). Returns the parsed
+    ``{op: {calls, bytes}}`` dict ({} when the HLO is unavailable)."""
+    from horovod_tpu.telemetry import instruments as _tele
+
+    try:
+        text = compiled if isinstance(compiled, str) else compiled.as_text()
+    except Exception:
+        return {}
+    ops = collective_bytes_from_hlo(text)
+    for op, tot in ops.items():
+        _tele.record_compiled_collective(
+            f"{prefix}_{op}", calls=tot["calls"], nbytes=tot["bytes"])
+    return ops

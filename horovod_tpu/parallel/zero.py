@@ -137,6 +137,11 @@ def _bucket_rows(schedule, idx, leaves):
     return flat.reshape(schedule.world, schedule.shard_sizes[idx])
 
 
+# the GSPMD plan layer (parallel/gspmd.py) packs gradients/params into
+# the same rows; public alias so it does not reach into a private name
+bucket_rows = _bucket_rows
+
+
 def init(tx, params, plan):
     """Initialize the wrapped optimizer over the bucket-row view of
     ``params``. Runs at top level (outside shard_map): the rows come out

@@ -342,6 +342,20 @@ def record_collective(op_name, nbytes, logical_nbytes=None):
     _ensure_ratio_gauge()
 
 
+def record_compiled_collective(op_name, calls, nbytes, logical_nbytes=None):
+    """Account collectives read off a COMPILED module (the GSPMD path —
+    ``parallel/gspmd.record_compiled_collectives``): there is no Python
+    dispatch to count per call, so the whole module's per-op totals are
+    recorded at once, in the same ``hvd_collective_*`` families the
+    per-dispatch path uses. Recorded once per compile — like the
+    trace-time counters, the numbers describe one compiled step."""
+    _calls_child(op_name).inc(max(0, int(calls)))
+    _bytes_child(op_name).inc(max(0, int(nbytes)))
+    _logical_bytes_child(op_name).inc(
+        max(0, int(nbytes if logical_nbytes is None else logical_nbytes)))
+    _ensure_ratio_gauge()
+
+
 def record_bucket(kind, fill_ratio, nbytes, dispatch_s=None,
                   logical_nbytes=None, dtype=None):
     """Bucketed reduce-scatter/all-gather pipeline instrumentation.

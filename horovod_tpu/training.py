@@ -72,16 +72,13 @@ def state_specs(state):
     """PartitionSpecs for a :class:`TrainState`: everything replicated,
     except ZeRO-sharded optimizer state (``parallel/zero.ZeroState``) whose
     bucket rows are sharded over their scatter axes — the ~1/N
-    optimizer-state memory is real, not just an algorithmic claim."""
-    from horovod_tpu.parallel import zero as zero_lib
+    optimizer-state memory is real, not just an algorithmic claim.
+    Delegates to ``parallel/gspmd.state_partition_specs`` — ONE spec
+    authority, shared by the explicit shard_map path, the GSPMD jit
+    path, placement and checkpointing."""
+    from horovod_tpu.parallel import gspmd as gspmd_lib
 
-    def one(node):
-        if isinstance(node, zero_lib.ZeroState):
-            return zero_lib.state_specs(node)
-        return jax.tree_util.tree_map(lambda _: P(), node)
-
-    return jax.tree_util.tree_map(
-        one, state, is_leaf=lambda x: isinstance(x, zero_lib.ZeroState))
+    return gspmd_lib.state_partition_specs(state)
 
 
 def _placer(mesh, spec):
@@ -113,8 +110,26 @@ def _placer(mesh, spec):
 def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
                     batch_axes=None, donate=True, dropout_seed=0,
                     accum_steps=1, overlap_grads=False, telemetry=None,
-                    error_feedback=True, loader=None):
+                    error_feedback=True, loader=None, spmd=False):
     """Build a jitted SPMD classification train step.
+
+    ``spmd=True`` selects the **GSPMD hot path** (docs/PERFORMANCE.md,
+    "The GSPMD path"): the whole step is jitted with
+    ``in_shardings``/``out_shardings`` derived from one
+    :class:`~horovod_tpu.parallel.gspmd.GspmdPlan` — batches sharded
+    over the data axes, params replicated, ZeRO-1 rows ``P(data)`` —
+    and contains **no explicit collective calls**; XLA inserts the
+    gradient reduction (and, for ``sharded_update``, the
+    reduce-scatter/all-gather pair) from the sharding annotations, and
+    the latency-hiding scheduler overlaps them with compute. Same
+    ``step(state, inputs, labels)`` contract and interchangeable
+    optimizer state/checkpoints. Semantics differences, documented:
+    BatchNorm normalizes with GLOBAL-batch statistics (sync-BN; the
+    explicit path is per-shard), and dropout draws one global stream.
+    ``accum_steps``/``overlap_grads`` are the explicit pipeline's knobs
+    and are rejected here; a wire-compressed optimizer falls back to
+    the explicit bucketed pipeline with a warning (the quantized
+    exchange has no annotation-only form — docs/PERFORMANCE.md).
 
     Returns ``step(state, inputs, labels) -> (state, loss)`` where
     ``inputs``/``labels`` are global arrays whose leading (batch) dim is
@@ -191,6 +206,14 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     from horovod_tpu import telemetry as telemetry_lib
     from horovod_tpu.ops import fusion
     from horovod_tpu.parallel import zero as zero_lib
+
+    if spmd:
+        return _make_spmd_train_step(
+            model, tx, mesh=mesh, loss_fn=loss_fn, batch_axes=batch_axes,
+            donate=donate, dropout_seed=dropout_seed,
+            accum_steps=accum_steps, overlap_grads=overlap_grads,
+            telemetry=telemetry, error_feedback=error_feedback,
+            loader=loader)
 
     tele_on = (telemetry_lib.enabled() if telemetry is None
                else bool(telemetry))
@@ -444,7 +467,7 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     if loader is not None:
         # stage prefetched batches straight to this step's mesh placement
         # on the PRODUCER thread — by dispatch time place_data is a no-op
-        loader.attach_placement(place_data)
+        loader.attach_placement(place_data, spec=P(data_axes))
 
     def _loader_batch():
         if loader is None:
@@ -616,6 +639,420 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     return step
 
 
+def _spmd_gate(tx, what):
+    """Shared validation for the GSPMD builders: version support and the
+    optimizer contract. Returns the resolved wire format (``None`` or a
+    compressor — the caller decides the fallback)."""
+    from horovod_tpu import compat, hvd_jax
+
+    ok, reason = compat.gspmd_supported()
+    if not ok:
+        raise RuntimeError(
+            f"{what}(spmd=True) needs the NamedSharding jit API: {reason}."
+            " Use the explicit pipeline (spmd=False) on this jax — "
+            "horovod_tpu/compat.py owns this gate.")
+    if not isinstance(tx, hvd_jax.HorovodOptimizer):
+        raise ValueError(
+            f"{what}(spmd=True) needs the optimizer built by "
+            "hvd.DistributedOptimizer(...) — the GSPMD step routes its "
+            "gradient reduction through the plan")
+    if tx.op != hvd_jax.Average:
+        raise ValueError(
+            f"the GSPMD step computes the global-batch mean loss — that "
+            f"is op=Average semantics; got {tx.op!r}. Adasum/Min/Max "
+            "reductions live on the explicit path (spmd=False)")
+    if tx.backward_passes_per_step > 1:
+        raise ValueError(
+            "backward_passes_per_step>1 has no GSPMD path — its "
+            "accumulator lives in the explicit pipeline")
+    return tx.compression
+
+
+class _SpmdProgram:
+    """The shared machinery of both GSPMD step flavors (classification
+    and LM): the lazily built jit wrapper — ``in_shardings``/
+    ``out_shardings`` need the first state's tree structure, so the jit
+    is constructed on first use and cached, one structure per step —
+    plus the once-per-build compiled-collective accounting and the AOT
+    lower. One copy, so a fix to either flavor cannot miss the other.
+
+    ``arg_specs`` are the PartitionSpecs of the non-state args (batch
+    leaves); ``n_scalar_outs`` counts the replicated scalar outputs
+    after the state (loss, optional grad norm)."""
+
+    def __init__(self, plan, global_step, arg_specs, n_scalar_outs,
+                 donate):
+        self.plan = plan
+        self._fn = global_step
+        self._arg_specs = tuple(arg_specs)
+        self._n_out = int(n_scalar_outs)
+        self._donate = donate
+        self.jitted = None
+        self.state_shardings = None
+        self._programs = {}  # aval key -> (executable, collectives)
+        self.compiled_collectives = None
+
+    def jitted_for(self, placed_state):
+        from horovod_tpu.parallel import gspmd as gspmd_lib
+
+        if self.jitted is None:
+            self.state_shardings = gspmd_lib.state_shardings(
+                self.plan, placed_state)
+            rep = self.plan.sharding(P())
+            self.jitted = jax.jit(
+                self._fn,
+                in_shardings=(self.state_shardings,) + tuple(
+                    self.plan.sharding(s) for s in self._arg_specs),
+                out_shardings=(self.state_shardings,) + (rep,)
+                * self._n_out,
+                donate_argnums=(0,) if self._donate else ())
+        return self.jitted
+
+    @staticmethod
+    def _aval_key(placed):
+        return tuple((tuple(jnp.shape(x)), str(jnp.result_type(x)))
+                     for x in jax.tree_util.tree_leaves(placed))
+
+    def executable(self, placed):
+        """ONE compile per argument-shape signature: AOT lower+compile
+        on first sight of a shape set (a shorter final batch from a
+        ``drop_last=False`` loader, an eval batch), then the cached
+        executable — the jit wrapper would retrace those transparently,
+        and this cache keeps that behavior instead of crashing on a
+        shape mismatch. The step wrappers CALL the executable (not the
+        jit wrapper): on this jax an AOT compile does not populate the
+        jit dispatch cache, so dispatching through the wrapper after
+        compiling for the byte accounting would compile the identical
+        module twice (minutes, on a real model). Each new program's
+        collectives are accounted as it is compiled — the same
+        once-per-compile semantics as the trace-time counters. Donation
+        and in/out shardings were fixed at jit construction and carry
+        into every executable."""
+        from horovod_tpu.parallel import gspmd as gspmd_lib
+
+        key = self._aval_key(placed)
+        entry = self._programs.get(key)
+        if entry is None:
+            compiled = self.jitted_for(placed[0]).lower(
+                *placed).compile()
+            try:
+                collectives = gspmd_lib.record_compiled_collectives(
+                    compiled)
+            except Exception:  # pragma: no cover - must not kill a step
+                collectives = {}
+            entry = (compiled, collectives)
+            self._programs[key] = entry
+        self.compiled_collectives = entry[1]
+        return entry[0]
+
+    def lower(self, placed):
+        """AOT lower with the executed path's placement — for
+        ``cost_analysis``-style callers; ``.compile()`` on the result
+        is a fresh compile (the executing path's artifact is
+        :meth:`executable`)."""
+        return self.jitted_for(placed[0]).lower(*placed)
+
+
+def _spmd_wire_drift_checker(tx):
+    """Per-step guard mirroring the explicit path's _check_wire_drift:
+    the GSPMD builders resolve the wire format ONCE at build (non-None
+    routes to the explicit fallback), but config.wire_dtype binds late —
+    an autotuner that installs its winner AFTER the step was built would
+    otherwise leave tx.compression claiming a format the running
+    program never applies. Warn once instead of silently diverging."""
+    warned = [False]
+
+    def check():
+        if warned[0]:
+            return
+        now = tx.compression
+        if now is not None:
+            warned[0] = True
+            import warnings
+            warnings.warn(
+                f"tx.compression now resolves to "
+                f"{getattr(now, 'name', None)!r} but this GSPMD step was "
+                "built uncompressed — the wire decision is made at build "
+                "time (a compressed build runs the explicit bucketed "
+                "fallback). Rebuild the step after installing "
+                "config.wire_dtype for it to take effect.", stacklevel=3)
+
+    return check
+
+
+def _make_spmd_train_step(model, tx, mesh=None,
+                          loss_fn=softmax_cross_entropy, batch_axes=None,
+                          donate=True, dropout_seed=0, accum_steps=1,
+                          overlap_grads=False, telemetry=None,
+                          error_feedback=True, loader=None):
+    """The GSPMD hot path behind ``make_train_step(spmd=True)`` — see
+    that docstring and ``parallel/gspmd.py`` for the contract."""
+    import time as _time
+    import warnings
+
+    from horovod_tpu import telemetry as telemetry_lib
+    from horovod_tpu.parallel import gspmd as gspmd_lib
+
+    wire = _spmd_gate(tx, "make_train_step")
+    if wire is not None:
+        # documented fallback (docs/PERFORMANCE.md, "The GSPMD path"):
+        # the quantized exchange carries per-chunk scales no sharding
+        # annotation can express, and a cast-width constraint cannot
+        # force the partitioner to MOVE bytes at the narrow width (the
+        # reduction happens where AD put it, before any cast) — so a
+        # wire-compressed optimizer runs the explicit bucketed pipeline,
+        # which implements exactly that exchange.
+        warnings.warn(
+            f"make_train_step(spmd=True) with wire compression "
+            f"({wire.name!r}): the quantize-RS-dequantize exchange has "
+            "no annotation-only form — falling back to the explicit "
+            "bucketed pipeline (overlap_grads=True; docs/PERFORMANCE.md"
+            ", 'The GSPMD path').", stacklevel=3)
+        return make_train_step(
+            model, tx, mesh=mesh, loss_fn=loss_fn, batch_axes=batch_axes,
+            donate=donate, dropout_seed=dropout_seed,
+            accum_steps=max(1, accum_steps), overlap_grads=True,
+            telemetry=telemetry, error_feedback=error_feedback,
+            loader=loader, spmd=False)
+    if accum_steps != 1 or overlap_grads:
+        raise ValueError(
+            "accum_steps/overlap_grads are the explicit pipeline's "
+            "microbatch knobs; the GSPMD step compiles the whole batch "
+            "and XLA's latency-hiding scheduler owns the compute/comms "
+            "overlap")
+
+    tele_on = (telemetry_lib.enabled() if telemetry is None
+               else bool(telemetry))
+    mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+    plan = gspmd_lib.derive_plan(mesh)
+    data_axes = tuple(batch_axes) if batch_axes else plan.data_axes
+    batch_spec = P(data_axes)
+
+    def global_step(state, inputs, labels):
+        # ONE global dropout stream per step: there is no per-shard rank
+        # to fold in — masks are drawn over the global batch (the
+        # explicit path draws per-shard streams; docs/PERFORMANCE.md)
+        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
+                                 state.step)
+
+        def compute_loss(params):
+            variables = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                logits, mutated = model.apply(
+                    variables, inputs, train=True,
+                    mutable=["batch_stats"], rngs={"dropout": rng})
+                return loss_fn(logits, labels), mutated["batch_stats"]
+            logits = model.apply(variables, inputs, train=True,
+                                 rngs={"dropout": rng})
+            return loss_fn(logits, labels), {}
+
+        (loss, stats), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(state.params)
+        gnorm = None
+        if tele_on:
+            # grads are the logical global-mean gradient — this is its
+            # exact L2 norm (same definition as the overlapped path)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(grads)))
+        updates, opt_state = tx.update_spmd(grads, state.opt_state,
+                                            state.params, plan)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(params=params, opt_state=opt_state,
+                               batch_stats=stats, step=state.step + 1)
+        if tele_on:
+            return new_state, loss, gnorm
+        return new_state, loss
+
+    place_data = _placer(mesh, batch_spec)
+
+    def place_state(state):
+        # ONE placement implementation (parallel/gspmd.place_state);
+        # once the program is built, its cached shardings tree is
+        # reused instead of re-deriving specs on every step
+        if prog.state_shardings is not None:
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), state,
+                prog.state_shardings)
+        return gspmd_lib.place_state(plan, state)
+
+    if loader is not None:
+        # prefetched batches are staged by the PRODUCER thread directly
+        # onto the plan's batch NamedSharding — they arrive matching the
+        # compiled step's in_shardings, so dispatch-time placement is a
+        # no-op
+        loader.attach_placement(place_data,
+                                spec=plan.sharding(batch_spec))
+
+    def _loader_batch():
+        if loader is None:
+            raise TypeError(
+                "step(state) with no batch needs a loader — build the "
+                "step with make_train_step(..., loader=...) or pass "
+                "(inputs, labels) explicitly")
+        batch = next(loader)
+        if not (isinstance(batch, (tuple, list)) and len(batch) == 2):
+            raise TypeError(
+                "the loader's source must yield (inputs, labels) "
+                f"batches for this step; got {type(batch).__name__}")
+        return batch[0], batch[1]
+
+    prog = _SpmdProgram(plan, global_step,
+                        arg_specs=(batch_spec, batch_spec),
+                        n_scalar_outs=2 if tele_on else 1,
+                        donate=donate)
+    _check_wire_drift = _spmd_wire_drift_checker(tx)
+
+    from horovod_tpu.diag import recorder as _flightrec
+    from horovod_tpu.telemetry import ledger as _ledger_lib
+
+    instruments = (telemetry_lib.StepInstruments() if tele_on else None)
+    _step_no = [0]
+
+    def step(state, inputs=None, labels=None):
+        if inputs is None:
+            inputs, labels = _loader_batch()
+        n = _step_no[0]
+        _step_no[0] = n + 1
+        _flightrec.step_begin(n)
+        placed = (place_state(state), place_data(inputs),
+                  place_data(labels))
+        _check_wire_drift()
+        ex = prog.executable(placed)  # one compile per shape signature
+        step.jitted = prog.jitted
+        step.compiled_collectives = prog.compiled_collectives
+        t0 = _time.perf_counter()
+        if tele_on:
+            new_state, loss, gnorm = ex(*placed)
+        else:
+            new_state, loss = ex(*placed)
+            gnorm = None
+        _flightrec.step_end(n)
+        _ledger_lib.get_ledger().settle_step()
+        if instruments is not None:
+            instruments.record_step(
+                batch=int(inputs.shape[0]),
+                dispatch_s=_time.perf_counter() - t0,
+                loss=loss, grad_norm=gnorm,
+                step_no=instruments.steps.value)
+        return new_state, loss
+
+    def lower(state, inputs, labels):
+        placed = (place_state(state), place_data(inputs),
+                  place_data(labels))
+        lowered = prog.lower(placed)
+        step.jitted = prog.jitted
+        return lowered
+
+    if instruments is not None:
+        step.instruments = instruments
+    step.jitted = None  # set at first build
+    step.lower = lower
+    step.loader = loader
+    step.place_data = place_data
+    step.plan = plan
+    step.spmd = True
+    step.compiled_collectives = None  # set at first call
+    step._settles_ledger = True
+    return step
+
+
+def _make_spmd_lm_train_step(model, tx, mesh=None, batch_axis="data",
+                             donate=True):
+    """The GSPMD LM step behind ``make_lm_train_step(spmd=True)``:
+    global next-token mean loss over the batch-sharded tokens, gradients
+    reduced by XLA from the shardings, no explicit collective calls."""
+    from horovod_tpu.parallel import gspmd as gspmd_lib
+
+    wire = _spmd_gate(tx, "make_lm_train_step")
+    if wire is not None:
+        # same documented fallback as the classification builder: the
+        # compressed exchange has no annotation-only form, so the
+        # explicit LM step (whose fused allreduce narrows to the wire
+        # format) carries the request
+        import warnings
+        warnings.warn(
+            f"make_lm_train_step(spmd=True) with wire compression "
+            f"({wire.name!r}): falling back to the explicit LM step "
+            "(docs/PERFORMANCE.md, 'The GSPMD path').", stacklevel=3)
+        return make_lm_train_step(model, tx, mesh=mesh,
+                                  batch_axis=batch_axis, seq_axis=None,
+                                  donate=donate, spmd=False)
+    mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+    plan = gspmd_lib.derive_plan(mesh)
+    token_spec = P(batch_axis)
+
+    def global_step(state, tokens):
+        def compute_loss(params):
+            logits = model.apply({"params": params}, tokens)
+            targets = tokens[:, 1:]
+            logits_t = (logits[:, :-1]
+                        if targets.shape[1] == logits.shape[1] - 1
+                        else logits)
+            logp = jax.nn.log_softmax(logits_t.astype(jnp.float32),
+                                      axis=-1)
+            ll = jnp.take_along_axis(logp, targets[..., None],
+                                     axis=-1)[..., 0]
+            # the global mean IS the exact loss — no allreduce of
+            # per-shard partial means to get right
+            return -jnp.mean(ll)
+
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        updates, opt_state = tx.update_spmd(grads, state.opt_state,
+                                            state.params, plan)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(params=params, opt_state=opt_state,
+                               batch_stats=state.batch_stats,
+                               step=state.step + 1)
+        return new_state, loss
+
+    place_tokens = _placer(mesh, token_spec)
+
+    def place_state(state):
+        if prog.state_shardings is not None:
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), state,
+                prog.state_shardings)
+        return gspmd_lib.place_state(plan, state)
+
+    prog = _SpmdProgram(plan, global_step, arg_specs=(token_spec,),
+                        n_scalar_outs=1, donate=donate)
+    _check_wire_drift = _spmd_wire_drift_checker(tx)
+
+    from horovod_tpu.diag import recorder as _flightrec
+    from horovod_tpu.telemetry import ledger as _ledger_lib
+    _step_no = [0]
+
+    def step(state, tokens):
+        n = _step_no[0]
+        _step_no[0] = n + 1
+        _flightrec.step_begin(n)
+        placed = (place_state(state), place_tokens(tokens))
+        _check_wire_drift()
+        ex = prog.executable(placed)  # one compile per shape signature
+        step.jitted = prog.jitted
+        step.compiled_collectives = prog.compiled_collectives
+        out = ex(*placed)
+        _flightrec.step_end(n)
+        _ledger_lib.get_ledger().settle_step()
+        return out
+
+    def lower(state, tokens):
+        placed = (place_state(state), place_tokens(tokens))
+        lowered = prog.lower(placed)
+        step.jitted = prog.jitted
+        return lowered
+
+    step.jitted = None
+    step.lower = lower
+    step.plan = plan
+    step.spmd = True
+    step.compiled_collectives = None
+    step._settles_ledger = True
+    return step
+
+
 def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
                        commit_every=1, checkpoint_every=None,
                        on_step=None):
@@ -732,8 +1169,13 @@ def elastic_train_loop(elastic_state, train_step, batch_fn, num_steps,
 
 
 def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
-                       seq_axis=None, donate=True):
+                       seq_axis=None, donate=True, spmd=False):
     """Build a jitted SPMD language-model train step (next-token loss).
+
+    ``spmd=True`` selects the GSPMD hot path (no explicit collectives;
+    see ``make_train_step``). It shards the batch axis only — ring
+    attention over ``seq_axis`` is an explicit shard_map schedule and
+    stays on the default path.
 
     ``tokens`` is ``[B, S]``; B is sharded over ``batch_axis`` and, when
     ``seq_axis`` is set, S over ``seq_axis`` with ring attention inside the
@@ -745,6 +1187,15 @@ def make_lm_train_step(model, tx, mesh=None, batch_axis="data",
     seq-parallel loss and gradient match the single-device full-sequence
     computation.
     """
+    if spmd:
+        if seq_axis is not None:
+            raise ValueError(
+                "make_lm_train_step(spmd=True) shards the batch axis "
+                "only; ring attention (seq_axis) is the explicit path's "
+                "shard_map schedule — drop seq_axis or spmd")
+        return _make_spmd_lm_train_step(model, tx, mesh=mesh,
+                                        batch_axis=batch_axis,
+                                        donate=donate)
     mesh = mesh if mesh is not None else mesh_lib.get_mesh()
     grad_axes = (batch_axis,) if seq_axis is None else (batch_axis, seq_axis)
     n_shards = int(np.prod([mesh.shape[a] for a in grad_axes]))

@@ -40,6 +40,18 @@ def synthetic_batch(global_batch, image_size, dtype=None, num_classes=1000,
     return images, labels
 
 
+def cost_analysis_dict(compiled):
+    """``compiled.cost_analysis()`` normalized across jax versions (some
+    return the per-device dict, some a 1-list of it) — the ONE copy;
+    bench.py and bench_roofline.py both read flops/bytes through it so
+    a version that returns the list form cannot zero one script's MFU
+    while the other reports correctly."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
 def sync(x):
     """Force TRUE completion by reading ONE element back to the host.
 
@@ -203,25 +215,42 @@ def slope_window(step_once, state, iters, base_iters=2, rounds=3,
     return WindowTime(per_iter * iters, asymmetric=asymmetric), state
 
 
-def repeat_throughput(step, state, images, labels, warmup, iters,
-                      repeats, base_iters=2):
-    """``repeats`` slope-timed windows (``slope_window``) over a
-    continuously evolving state (donation-safe: the caller's state is
-    consumed once and threaded through), returning a list of
-    ``(img_per_sec, dt)`` where ``dt`` is a ``WindowTime`` — check its
-    ``upper_bound`` flag to tell slope measurements from inverted-window
-    conservative bounds. Warmup (first repeat only) covers compilation;
-    later windows are warm by construction."""
+def repeat_step_windows(step_once, state, warmup, iters, repeats,
+                        base_iters=2):
+    """THE warm-then-measure discipline, step-shape-agnostic: ``warmup``
+    synced calls (covers compilation; later windows are warm by
+    construction), then ``repeats`` slope windows over the continuously
+    evolving state (donation-safe — consumed once, threaded through).
+    ``step_once(state) -> (state, syncable)``. Returns
+    ``(list[WindowTime], state)`` — the ``upper_bound``/``asymmetric``
+    flags ride along, so every caller can tell measurements from
+    inverted-window bounds. One copy: ``repeat_throughput`` (the
+    (images, labels) classification shape), bench.py's LM comparison
+    and bench_roofline's LM roofline all delegate here, so the timing
+    discipline cannot drift between scripts."""
     for _ in range(warmup):
-        state, loss = step(state, images, labels)
-        sync(loss)
+        state, out = step_once(state)
+        sync(out)
     runs = []
     for _ in range(repeats):
-        dt, state = slope_window(
-            lambda st: step(st, images, labels), state, iters,
-            base_iters=base_iters)
-        runs.append((images.shape[0] * iters / dt, dt))
-    return runs
+        dt, state = slope_window(step_once, state, iters,
+                                 base_iters=base_iters)
+        runs.append(dt)
+    return runs, state
+
+
+def repeat_throughput(step, state, images, labels, warmup, iters,
+                      repeats, base_iters=2):
+    """``repeats`` slope-timed windows of a ``step(state, images,
+    labels)`` classification step, returning a list of
+    ``(img_per_sec, dt)`` where ``dt`` is a ``WindowTime`` — check its
+    ``upper_bound`` flag to tell slope measurements from inverted-window
+    conservative bounds. The (images, labels) view of
+    :func:`repeat_step_windows`."""
+    dts, _ = repeat_step_windows(
+        lambda st: step(st, images, labels), state, warmup, iters,
+        repeats, base_iters=base_iters)
+    return [(images.shape[0] * iters / dt, dt) for dt in dts]
 
 
 def timed_throughput(step, state, images, labels, warmup, iters):
@@ -234,12 +263,17 @@ def timed_throughput(step, state, images, labels, warmup, iters):
 
 
 def make_lm_bench(*, mesh, seq_axis, batch, seq_len, layers, d_model,
-                 heads, vocab, flash, dtype=None, lr=3e-4):
+                 heads, vocab, flash, dtype=None, lr=3e-4, spmd=False,
+                 compression=None):
     """Build the LM benchmark workload ONE way — ``bench.py`` and
     ``examples/jax_lm_benchmark.py`` share it so their numbers describe
     the same program: exact sharded LM loss through
     ``DistributedOptimizer`` on a (data, seq) mesh. Returns
-    ``(step, state, tokens)``; ``flash=None`` means the auto default."""
+    ``(step, state, tokens)``; ``flash=None`` means the auto default.
+    ``spmd=True`` builds the GSPMD LM step (``make_lm_train_step(
+    spmd=True)`` — batch sharding only) and ``compression`` the wire
+    format, so ``bench.py --spmd`` runs the same workload through every
+    exchange variant."""
     import optax
 
     import horovod_tpu as hvd
@@ -260,7 +294,8 @@ def make_lm_bench(*, mesh, seq_axis, batch, seq_len, layers, d_model,
     init_cfg = TransformerConfig(**{**cfg.__dict__, "sequence_axis": None,
                                     "flash_attention": False})
     tx = hvd.DistributedOptimizer(
-        optax.adamw(lr), axes=("data", "seq") if seq_axis else ("data",))
+        optax.adamw(lr), axes=("data", "seq") if seq_axis else ("data",),
+        compression=compression)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)),
                          jnp.int32)
@@ -268,5 +303,5 @@ def make_lm_bench(*, mesh, seq_axis, batch, seq_len, layers, d_model,
                                         jax.random.PRNGKey(0), tokens[:1])
     step = training.make_lm_train_step(Transformer(cfg), tx, mesh=mesh,
                                        batch_axis="data",
-                                       seq_axis=seq_axis)
+                                       seq_axis=seq_axis, spmd=spmd)
     return step, state, tokens

@@ -158,6 +158,56 @@ def test_overlap_variants_extend_with_wire_formats():
         bench.overlap_variants(["float3"])
 
 
+def test_lm_roofline_emits_bound_json(hvd, capsys, monkeypatch):
+    """bench_roofline --lm (ISSUE 10 satellite): the d2048 LM MFU must
+    be judged against the step's ACTUAL roofline bound. Runs the real
+    compiled-step + cost_analysis machinery on a tiny transformer with
+    the ceiling calibrations stubbed (a CPU box cannot sweep 8192-cubed
+    bf16 matmuls in a unit test) and checks the JSON contract:
+    lm_roofline_achieved_over_bound with the bound fields populated."""
+    import argparse
+    import json
+    import sys
+
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import bench
+    import bench_roofline
+
+    monkeypatch.setattr(bench, "calibrate_peak_tflops",
+                        lambda repeats=3: (100.0, 4096))
+    monkeypatch.setattr(bench_roofline, "measure_hbm_bandwidth",
+                        lambda *a, **k: 500.0)
+    args = argparse.Namespace(lm_batch=2, lm_seq_len=64, lm_layers=1,
+                              lm_heads=2, lm_d_model=32, lm_vocab=64,
+                              num_iters=2, repeats=1)
+    bench_roofline.lm_roofline(args)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "lm_roofline_achieved_over_bound"
+    assert out["unit"] == "ratio"
+    assert out["t_bound_ms"] == pytest.approx(
+        max(out["t_compute_ms"], out["t_memory_ms"]))
+    assert out["bound_by"] in ("compute", "memory")
+    assert out["lm_d_model"] == 32 and out["tokens_per_sec"] > 0
+    if out["flops_per_step"] > 0:
+        assert out["value"] is not None
+        assert out["mfu_bound_pct"] <= 100.0
+
+
+def test_spmd_bench_mode_is_exclusive():
+    """bench.py --spmd is its own comparison mode: combining it with
+    --overlap/--compression/--data-plane must die at argument parsing,
+    before any compile."""
+    import subprocess
+    import sys
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--spmd", "--overlap"],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "--spmd is its own comparison mode" in proc.stderr
+
+
 def test_goodput_block_invariant_validation():
     """The BENCH `goodput` block contract (ISSUE 9 satellite): the phase
     sum must explain ~100% of wall time — an unattributed gap >2% (or a

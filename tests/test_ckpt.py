@@ -113,6 +113,117 @@ def test_reshard_restore_bitwise_parity(tmp_path, m):
                                       np.asarray(v))
 
 
+@pytest.mark.parametrize("m", [3, 2])
+def test_gspmd_sharded_state_saved_at_one_process_reshards_bitwise(
+        tmp_path, m):
+    """The GSPMD hot path (parallel/gspmd.py): ONE process drives the
+    whole mesh, so its ZeroState rows are a single ``[world, shard]``
+    NamedSharding array and the process owns EVERY row. A save with
+    rank=0, world=1 must persist all of them (not just row 0 — the
+    pre-GSPMD assumption), and restore at a different world M must stay
+    bitwise — the same reshard oracle the explicit path pins."""
+    import horovod_tpu as hvd_mod
+
+    hvd_mod.shutdown()
+    hvd_mod.init()
+    try:
+        from horovod_tpu.parallel import gspmd
+        mesh = hvd_mod.mesh()
+        world = len(jax.devices())
+        params = _params()
+        grads = jax.tree_util.tree_map(lambda x: jnp.ones_like(x) * 0.37,
+                                       params)
+        tx = optax.adam(1e-2)
+        z8, sched8 = _rows_state(tx, params, grads, world=world)
+        # place the rows on the mesh exactly as the spmd step does:
+        # P('data') over dim 0, one row per device
+        plan = gspmd.derive_plan(mesh)
+        z8 = gspmd.place_state(plan, z8)
+        row0 = jax.tree_util.tree_leaves(z8.inner)[1]  # mu b0
+        assert {s.data.shape[0] for s in row0.addressable_shards} == {1}
+
+        _save_world(str(tmp_path), 4, {"opt": z8}, 1)  # ONE process
+
+        full = tx.init(params)
+        for _ in range(3):
+            _, full = tx.update(grads, full, params)
+        mu_leaves = jax.tree_util.tree_leaves(full[0].mu)
+        nu_leaves = jax.tree_util.tree_leaves(full[0].nu)
+
+        zm, sched_m = _rows_state(tx, params, grads, world=m, steps=0)
+        step, restored, _ = ckpt_lib.restore_sharded(
+            str(tmp_path), {"opt": zm})
+        assert step == 4
+        inner = restored["opt"].inner
+        assert int(np.asarray(inner[0].count)) == 3
+        for i, bucket in enumerate(sched_m.buckets):
+            used = int(sum(bucket.sizes))
+            for got_rows, oracle in ((inner[0].mu, mu_leaves),
+                                     (inner[0].nu, nu_leaves)):
+                got = np.asarray(got_rows[f"b{i}"])
+                assert got.shape == (m, sched_m.shard_sizes[i])
+                np.testing.assert_array_equal(
+                    got.reshape(-1)[:used],
+                    np.asarray(fusion._pack(bucket, oracle))[:used])
+                np.testing.assert_array_equal(got.reshape(-1)[used:], 0.0)
+    finally:
+        hvd_mod.shutdown()
+
+
+def test_legacy_single_row_checkpoint_loads_into_gspmd_target(tmp_path):
+    """A checkpoint written by the pre-GSPMD layout (one UNKEYED row per
+    rank shard) restores into a GSPMD-worldsize target bitwise, and the
+    restored tree places cleanly onto the plan's NamedShardings — the
+    explicit-layout -> GSPMD migration path."""
+    import horovod_tpu as hvd_mod
+
+    params = _params()
+    grads = jax.tree_util.tree_map(lambda x: jnp.ones_like(x) * 0.5,
+                                   params)
+    tx = optax.adam(1e-2)
+    z4, _ = _rows_state(tx, params, grads, world=4)
+
+    # write world=4 shards in the LEGACY format: rows[key] = bare array
+    zi = None
+    for r in range(4):
+        payload, zi = ckpt_lib.snapshot_tree({"opt": z4}, r, 4)
+        for zslot in payload["zero"].values():
+            zslot["rows"] = {
+                key: rows[str(r)] for key, rows in zslot["rows"].items()}
+        sharded_lib.write_shard(str(tmp_path), 2, payload)
+    manifest_lib.commit(str(tmp_path), 2, 0, 4, zero_info=zi, keep=None)
+
+    full = tx.init(params)
+    for _ in range(3):
+        _, full = tx.update(grads, full, params)
+    mu_leaves = jax.tree_util.tree_leaves(full[0].mu)
+
+    world = len(jax.devices())
+    zt, sched_t = _rows_state(tx, params, grads, world=world, steps=0)
+    step, restored, _ = ckpt_lib.restore_sharded(str(tmp_path), {"opt": zt})
+    assert step == 2
+    inner = restored["opt"].inner
+    for i, bucket in enumerate(sched_t.buckets):
+        used = int(sum(bucket.sizes))
+        got = np.asarray(inner[0].mu[f"b{i}"])
+        np.testing.assert_array_equal(
+            got.reshape(-1)[:used],
+            np.asarray(fusion._pack(bucket, mu_leaves))[:used])
+
+    # the restored host tree must place onto the GSPMD plan's shardings
+    import horovod_tpu as hvd_mod2
+    hvd_mod2.shutdown()
+    hvd_mod2.init()
+    try:
+        from horovod_tpu.parallel import gspmd
+        plan = gspmd.derive_plan(hvd_mod2.mesh())
+        placed = gspmd.place_state(plan, restored["opt"])
+        leaf = placed.inner[0].mu["b0"]
+        assert {s.data.shape[0] for s in leaf.addressable_shards} == {1}
+    finally:
+        hvd_mod2.shutdown()
+
+
 def test_reshard_rejects_mismatched_bucket_layout(tmp_path):
     """A different fusion threshold partitions different buckets; the
     manifest's used_sizes must make that restore fail loudly instead of

@@ -1,0 +1,424 @@
+"""GSPMD hot path (ISSUE 10): one logical mesh, NamedSharding-compiled
+collectives. Pins the plan's spec derivation, the spmd train step's
+parity with the explicit overlap+ZeRO pipeline (the dryrun 1b4 contract,
+run here as the tier-1 smoke), the compiled-HLO byte accounting, the
+wire-compression fallback, the compat gate — and the tier-1 GUARD that
+keeps the hot path ON the mesh: no new ``pmap(``/``shard_map(`` call
+sites may appear in ``horovod_tpu/`` outside the pinned baseline
+(``compat.py`` and ``parallel/gspmd.py`` excluded as the shim layers)."""
+
+import os
+import re
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_api
+from horovod_tpu import compat, training
+from horovod_tpu.models.simple import MLP
+from horovod_tpu.parallel import gspmd
+from horovod_tpu.parallel import mesh as mesh_lib
+
+_PKG = os.path.join(os.path.dirname(__file__), os.pardir, "horovod_tpu")
+
+
+# ---- tier-1 guard: the hot path stays on the mesh ---------------------
+
+# Pinned per-file pmap(/shard_map( call-site baseline. compat.py (the
+# version shim) and parallel/gspmd.py (the NamedSharding plan layer)
+# are excluded by design. If you are editing this dict: a NEW explicit
+# per-rank call site moves work OFF the one logical mesh and out of the
+# partitioner's reach — justify it in the PR, or express the sharding
+# as a NamedSharding/with_sharding_constraint instead.
+_SHARD_MAP_BASELINE = {
+    "training.py": 2,             # explicit classification + LM steps
+    "ops/collective.py": 1,       # eager Adasum staged tree
+    "ops/fusion.py": 1,           # autotune trial harness
+    "parallel/pipeline.py": 2,    # GPipe + 1F1B schedules
+}
+_EXCLUDED = {"compat.py", os.path.join("parallel", "gspmd.py")}
+
+
+def test_guard_no_new_pmap_or_shard_map_call_sites():
+    pat = re.compile(r"\b(?:pmap|shard_map)\(")
+    found = {}
+    for dirpath, _, files in os.walk(_PKG):
+        if "__pycache__" in dirpath:
+            continue
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            rel = os.path.relpath(path, _PKG)
+            if rel in _EXCLUDED:
+                continue
+            with open(path) as fh:
+                n = len(pat.findall(fh.read()))
+            if n:
+                found[rel] = n
+    for rel, n in sorted(found.items()):
+        allowed = _SHARD_MAP_BASELINE.get(rel, 0)
+        assert n <= allowed, (
+            f"{rel} has {n} pmap(/shard_map( call site(s), baseline "
+            f"allows {allowed}: the hot path must stay on the logical "
+            "mesh (NamedSharding + with_sharding_constraint, "
+            "parallel/gspmd.py) — see this test's header before "
+            "raising the baseline")
+    # the guard is a RATCHET: when call sites are removed, the baseline
+    # must shrink with them, or the slack quietly readmits a new one
+    stale = {rel: allowed for rel, allowed in _SHARD_MAP_BASELINE.items()
+             if found.get(rel, 0) < allowed}
+    assert not stale, (
+        f"baseline overstates call sites ({stale} vs found "
+        f"{ {r: found.get(r, 0) for r in stale} }): shrink "
+        "_SHARD_MAP_BASELINE so the removed sites cannot silently "
+        "come back")
+
+
+# ---- plan derivation --------------------------------------------------
+
+def test_derive_plan_specs(hvd):
+    plan = gspmd.derive_plan()
+    assert plan.data_axes == ("data",)
+    assert plan.batch_spec == P(("data",))
+    assert plan.world() == len(jax.devices())
+    with pytest.raises(ValueError, match="model_axis"):
+        gspmd.derive_plan(model_axis="nope")
+
+
+def test_derive_plan_2d_mesh(hvd2d):
+    plan = gspmd.derive_plan()
+    assert set(plan.data_axes) == {"dcn", "data"}
+    assert plan.world() == len(jax.devices())
+
+
+def test_state_partition_specs_shards_zero_rows(hvd):
+    from horovod_tpu.parallel import zero
+    params = {"w": jnp.ones((40,)), "b": jnp.ones((8,))}
+    tx = hvd_api.DistributedOptimizer(optax.adam(1e-2),
+                                      sharded_update=True)
+    state = training.create_train_state(MLP(features=(4,)), tx,
+                                        jax.random.PRNGKey(0),
+                                        jnp.ones((1, 8)))
+    del params
+    specs = training.state_specs(state)  # delegates to gspmd
+    assert isinstance(specs.opt_state, zero.ZeroState)
+    row_specs = [s for s in jax.tree_util.tree_leaves(
+        specs.opt_state.inner, is_leaf=lambda x: isinstance(x, P))
+        if s == P(("data",))]
+    assert row_specs, "no ZeRO row leaf got the P('data') spec"
+    for s in jax.tree_util.tree_leaves(
+            specs.params, is_leaf=lambda x: isinstance(x, P)):
+        assert s == P()
+
+
+# ---- the spmd step: dryrun 1b4 parity as the tier-1 smoke -------------
+
+def test_spmd_step_matches_explicit_overlap_zero1(hvd):
+    """The 1b4 contract on the full 8-device mesh: same model/optimizer
+    stepped by both hot paths on identical tiled batches -> same loss
+    trajectory and params, genuinely sharded ZeRO rows, XLA-inserted
+    collectives in the compiled module."""
+    import __graft_entry__ as graft
+    graft._dryrun_gspmd(jax.devices())
+
+
+def test_spmd_plain_dp_matches_explicit(hvd):
+    """Non-sharded (plain DP) GSPMD: tx.update_spmd routes through the
+    preserved optimizer chain, so state stays interchangeable."""
+    n = len(jax.devices())
+    rng = np.random.default_rng(5)
+    sx = rng.standard_normal((2, 10))
+    sy = rng.integers(0, 3, size=(2,))
+    X = jnp.asarray(np.tile(sx, (n, 1)), jnp.float32)
+    y = jnp.asarray(np.tile(sy, n), jnp.int32)
+    model = MLP(features=(16, 3))
+
+    def run(spmd):
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(1), X[:1])
+        step = training.make_train_step(model, tx, donate=False,
+                                        spmd=spmd)
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, X, y)
+            losses.append(float(loss))
+        return np.asarray(losses), state
+
+    ex, ex_state = run(False)
+    sp, sp_state = run(True)
+    np.testing.assert_allclose(sp, ex, rtol=1e-5, atol=1e-7)
+    for a, b in zip(jax.tree_util.tree_leaves(ex_state.opt_state),
+                    jax.tree_util.tree_leaves(sp_state.opt_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_spmd_lm_step_matches_explicit(hvd):
+    """GSPMD LM step: global-mean next-token loss over batch-sharded
+    tokens tracks the explicit LM step's exact sharded loss."""
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+    n = len(jax.devices())
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                            d_model=16, d_ff=32, dtype=jnp.float32)
+    model = Transformer(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 32, size=(2 * n, 16)), jnp.int32)
+
+    def run(spmd):
+        tx = hvd_api.DistributedOptimizer(optax.adam(1e-2))
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(2),
+                                            tokens[:1])
+        step = training.make_lm_train_step(model, tx, donate=False,
+                                           spmd=spmd)
+        losses = []
+        for _ in range(4):
+            state, loss = step(state, tokens)
+            losses.append(float(loss))
+        return np.asarray(losses)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_spmd_step_with_loader(hvd):
+    """make_train_step(spmd=True, loader=...) stages batches to the
+    plan's batch sharding and step(state) pulls them."""
+    from horovod_tpu.data import ArraySource, PrefetchLoader
+    n = len(jax.devices())
+    B = 2 * n
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((4 * B, 6)).astype(np.float32)
+    y = rng.integers(0, 3, size=(4 * B,)).astype(np.int32)
+    model = MLP(features=(8, 3))
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.05))
+    loader = PrefetchLoader(ArraySource([X, y]), B, rank=0, world=1,
+                            shuffle=False)
+    try:
+        step = training.make_train_step(model, tx, donate=False,
+                                        spmd=True, loader=loader)
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0),
+                                            jnp.asarray(X[:1]))
+        # the staging target is introspectable: the plan's batch
+        # NamedSharding, so prefetched batches arrive matching the
+        # compiled step's in_shardings
+        assert isinstance(loader.placement_spec,
+                          jax.sharding.NamedSharding)
+        assert loader.placement_spec.spec == P(("data",))
+        for _ in range(3):
+            state, loss = step(state)
+        assert np.isfinite(float(loss))
+    finally:
+        loader.close()
+
+
+# ---- guards and fallbacks ---------------------------------------------
+
+def test_spmd_rejects_explicit_pipeline_knobs(hvd):
+    model = MLP(features=(4,))
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.1))
+    with pytest.raises(ValueError, match="explicit pipeline"):
+        training.make_train_step(model, tx, spmd=True, accum_steps=2)
+    tx_adasum = hvd_api.DistributedOptimizer(optax.sgd(0.1),
+                                             op=hvd_api.Adasum)
+    with pytest.raises(ValueError, match="Average"):
+        training.make_train_step(model, tx_adasum, spmd=True)
+
+
+def test_spmd_wire_compression_falls_back_to_bucketed(hvd):
+    """A wire-compressed optimizer has no annotation-only exchange: the
+    spmd builder must WARN and hand back the explicit bucketed pipeline
+    (docs/PERFORMANCE.md, 'The GSPMD path'), which still trains."""
+    n = len(jax.devices())
+    X = jnp.asarray(np.ones((2 * n, 6)), jnp.float32)
+    y = jnp.asarray(np.zeros((2 * n,)), jnp.int32)
+    model = MLP(features=(8, 3))
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.05),
+                                      sharded_update=True,
+                                      compression="int8")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step = training.make_train_step(model, tx, donate=False,
+                                        spmd=True)
+    assert any("falling back to the explicit bucketed pipeline"
+               in str(x.message) for x in w)
+    assert not getattr(step, "spmd", False)  # the explicit build
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        X[:1])
+    state, loss = step(state, X, y)
+    assert np.isfinite(float(loss))
+
+
+def test_spmd_step_retraces_on_new_batch_shape(hvd):
+    """A different batch shape (drop_last=False tail batch, an eval
+    batch) must compile a second program and keep running — the jit
+    wrapper would retrace transparently, and the AOT executable cache
+    has to preserve that instead of crashing on a shape mismatch."""
+    n = len(jax.devices())
+    model = MLP(features=(8, 3))
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.05))
+    step = training.make_train_step(model, tx, donate=False, spmd=True)
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        jnp.ones((1, 6)))
+    X1 = jnp.ones((2 * n, 6)); y1 = jnp.zeros((2 * n,), jnp.int32)
+    X2 = jnp.ones((4 * n, 6)); y2 = jnp.zeros((4 * n,), jnp.int32)
+    state, l1 = step(state, X1, y1)
+    state, l2 = step(state, X2, y2)  # new shape: second program
+    state, l3 = step(state, X1, y1)  # first program again, cached
+    assert all(np.isfinite(float(v)) for v in (l1, l2, l3))
+
+
+def test_spmd_step_warns_on_late_wire_install(hvd):
+    """config.wire_dtype binds late on the explicit path; the GSPMD
+    step bakes its (uncompressed) decision at build — installing a wire
+    format AFTER building must WARN at the next step instead of
+    silently running uncompressed while tx.compression claims int8."""
+    from horovod_tpu import basics
+
+    n = len(jax.devices())
+    X = jnp.ones((2 * n, 6)); y = jnp.zeros((2 * n,), jnp.int32)
+    model = MLP(features=(8, 3))
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.05))
+    step = training.make_train_step(model, tx, donate=False, spmd=True)
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        X[:1])
+    state, _ = step(state, X, y)
+    old = basics._state.config.wire_dtype
+    basics._state.config.wire_dtype = "int8"
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            state, _ = step(state, X, y)
+        assert any("built uncompressed" in str(x.message) for x in w)
+    finally:
+        basics._state.config.wire_dtype = old
+
+
+def test_spmd_gate_reports_reason(hvd, monkeypatch):
+    monkeypatch.setattr(compat, "gspmd_supported",
+                        lambda: (False, "synthetic: no NamedSharding"))
+    model = MLP(features=(4,))
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.1))
+    with pytest.raises(RuntimeError, match="synthetic: no NamedSharding"):
+        training.make_train_step(model, tx, spmd=True)
+
+
+def test_gspmd_supported_on_this_jax():
+    ok, reason = compat.gspmd_supported()
+    assert ok, reason
+
+
+# ---- compiled-HLO byte accounting -------------------------------------
+
+def test_collective_bytes_from_hlo_parses_result_shapes():
+    hlo = "\n".join([
+        "%ar = f32[4,16]{1,0} all-reduce(f32[4,16]{1,0} %x), meta",
+        "%ag = bf16[8,8]{1,0} all-gather(bf16[1,8]{1,0} %y), dims={0}",
+        "%rs = f32[2]{0} reduce-scatter(f32[16]{0} %z), dims={0}",
+        "%dot = f32[4,4]{1,0} dot(f32[4,8] %a, f32[8,4] %b)",
+    ])
+    got = gspmd.collective_bytes_from_hlo(hlo)
+    assert got["all-reduce"] == {"calls": 1, "bytes": 4 * 16 * 4}
+    assert got["all-gather"] == {"calls": 1, "bytes": 8 * 8 * 2}
+    assert got["reduce-scatter"] == {"calls": 1, "bytes": 2 * 4}
+    assert "dot" not in got
+
+
+def test_collective_bytes_from_hlo_parses_async_start_done_pairs():
+    """With the latency-hiding scheduler (the TPU configuration this
+    path targets), collectives lower to -start/-done PAIRS: the -start
+    must be counted once under the base op name — an async all-gather's
+    tuple result counts only its OUTPUT element — and the -done must be
+    skipped (counting both would double every collective)."""
+    hlo = "\n".join([
+        "%ars = f32[4,16]{1,0} all-reduce-start(f32[4,16]{1,0} %x)",
+        "%ard = f32[4,16]{1,0} all-reduce-done(f32[4,16]{1,0} %ars)",
+        "%ags = (bf16[1,8]{1,0}, bf16[8,8]{1,0}) "
+        "all-gather-start(bf16[1,8]{1,0} %y), dimensions={0}",
+        "%agd = bf16[8,8]{1,0} all-gather-done((bf16[1,8]{1,0}, "
+        "bf16[8,8]{1,0}) %ags)",
+    ])
+    got = gspmd.collective_bytes_from_hlo(hlo)
+    assert got["all-reduce"] == {"calls": 1, "bytes": 4 * 16 * 4}
+    assert got["all-gather"] == {"calls": 1, "bytes": 8 * 8 * 2}
+    assert set(got) == {"all-reduce", "all-gather"}
+
+    # variadic async (AllReduceCombiner fuses k tensors into ONE
+    # -start whose tuple is k aliased inputs + k outputs): the output
+    # HALF must be counted, not just the last element
+    variadic = ("%vars = (f32[64]{0}, f32[32]{0}, f32[64]{0}, "
+                "f32[32]{0}) all-reduce-start(f32[64]{0} %a, "
+                "f32[32]{0} %b)")
+    got = gspmd.collective_bytes_from_hlo(variadic)
+    assert got["all-reduce"] == {"calls": 1, "bytes": (64 + 32) * 4}
+
+    # collective-permute-start carries trailing u32[] context handles
+    # after the (operand, output) pair — they are not payload, and the
+    # half-split must not land on them
+    permute = ("%cps = (f32[16]{0}, f32[16]{0}, u32[], u32[]) "
+               "collective-permute-start(f32[16]{0} %p), "
+               "source_target_pairs={{0,1}}")
+    got = gspmd.collective_bytes_from_hlo(permute)
+    assert got["collective-permute"] == {"calls": 1, "bytes": 16 * 4}
+
+
+def test_spmd_step_records_compiled_collectives(hvd):
+    """The compiled path's byte accounting lands in the standard
+    hvd_collective_* families under spmd_* op labels — once per
+    compile, read off the module XLA actually produced."""
+    from horovod_tpu import telemetry
+    from horovod_tpu.telemetry import instruments as ti
+
+    n = len(jax.devices())
+    X = jnp.asarray(np.ones((2 * n, 6)), jnp.float32)
+    y = jnp.asarray(np.zeros((2 * n,)), jnp.int32)
+    model = MLP(features=(8, 3))
+    tx = hvd_api.DistributedOptimizer(optax.adam(0.05),
+                                      sharded_update=True)
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        X[:1])
+    step = training.make_train_step(model, tx, donate=False, spmd=True)
+
+    def spmd_bytes():
+        fam = telemetry.get_registry().get(ti.COLLECTIVE_BYTES)
+        s = fam.sample() if fam is not None else {}
+        if not isinstance(s, dict):
+            return 0.0
+        return sum(v for k, v in s.items()
+                   if any(str(p).startswith("spmd_") for p in k))
+
+    before = spmd_bytes()
+    state, _ = step(state, X, y)
+    after = spmd_bytes()
+    assert step.compiled_collectives, "no collectives parsed"
+    assert after > before
+    parsed = sum(t["bytes"] for t in step.compiled_collectives.values())
+    assert after - before == pytest.approx(parsed)
+    # once per compile, not per step
+    state, _ = step(state, X, y)
+    assert spmd_bytes() == after
+
+
+def test_spmd_state_place_roundtrip(hvd):
+    """place_state puts ZeRO rows on their NamedShardings; re-placing
+    is a no-op (stable input shardings — no recompiles)."""
+    model = MLP(features=(8, 3))
+    tx = hvd_api.DistributedOptimizer(optax.adam(0.05),
+                                      sharded_update=True)
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        jnp.ones((1, 6)))
+    plan = gspmd.derive_plan()
+    placed = gspmd.place_state(plan, state)
+    row = placed.opt_state.inner[0].mu["b0"]
+    assert {s.data.shape[0] for s in row.addressable_shards} == {1}
+    again = gspmd.place_state(plan, placed)
+    assert again.opt_state.inner[0].mu["b0"].sharding == row.sharding
