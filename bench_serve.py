@@ -1,0 +1,197 @@
+"""Serving-plane benchmark: open-loop load against the inference engine.
+
+The serving analogue of bench.py: drive `horovod_tpu/serve`'s
+continuous-batching engine with a synthetic **open-loop** arrival
+schedule (requests arrive on a fixed clock, independent of completions
+— the honest way to measure a server at and past saturation; a
+closed-loop client self-throttles and hides queueing) and report the
+SLO numbers docs/SERVING.md names:
+
+* ``ttft_ms_p50`` / ``ttft_ms_p99``   — time to first token (arrival →
+  first streamed token: queueing + prefill),
+* ``inter_token_ms_p50`` / ``_p99``   — gaps between streamed tokens
+  (steady-state decode cadence),
+* ``tokens_per_sec_per_chip``         — generated-token throughput,
+  normalized by the mesh's device count,
+
+plus a goodput-style **time-attribution block**: the engine's
+prefill / decode / overhead phase accounting + the harness's idle
+bookkeeping must explain ~100% of wall clock (the serving analogue of
+bench.py's goodput invariant — `SERVE ATTRIBUTION VIOLATED` printed
+loudly when it doesn't; tolerance mirrors
+telemetry/report.UNATTRIBUTED_TOLERANCE).
+
+Runs on the 8-device CPU mesh exactly like the rest of the bench suite
+(`JAX_PLATFORMS=cpu python bench_serve.py`); the numbers are CPU-mesh
+numbers — the harness, shapes and invariants are what transfer to TPU.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+ATTRIBUTION_TOLERANCE = 0.02  # mirror telemetry/report's goodput bound
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="horovod_tpu serving bench")
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--rate", type=float, default=8.0,
+                   help="open-loop arrival rate, requests/second")
+    p.add_argument("--prompt-len", type=int, default=24,
+                   help="mean prompt length (uniform 0.5x..1.5x)")
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--max-slots", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=16)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--vocab-size", type=int, default=512)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--d-ff", type=int, default=512)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", default=None,
+                   help="also write the result block to this path")
+    return p
+
+
+def _percentiles_ms(samples, qs=(50, 99)):
+    if not samples:
+        return {f"p{q}": None for q in qs}
+    arr = np.asarray(samples, dtype=np.float64) * 1e3
+    return {f"p{q}": round(float(np.percentile(arr, q)), 3) for q in qs}
+
+
+def run_bench(args):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+    from horovod_tpu.parallel import mesh as mesh_lib
+    from horovod_tpu.serve import KVCacheConfig, Request, ServeEngine
+
+    rng = np.random.default_rng(args.seed)
+    cfg = TransformerConfig(
+        vocab_size=args.vocab_size, num_layers=args.num_layers,
+        num_heads=args.num_heads, d_model=args.d_model, d_ff=args.d_ff,
+        dtype=jnp.float32, flash_attention=False)
+    model = Transformer(cfg)
+    init_toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(args.seed), init_toks)["params"]
+
+    prompt_lens = rng.integers(max(1, args.prompt_len // 2),
+                               args.prompt_len * 3 // 2 + 1,
+                               args.requests)
+    max_seq = int(prompt_lens.max()) + args.max_new
+    mbps = -(-max_seq // args.block_size)
+    kv = KVCacheConfig(
+        num_blocks=args.max_slots * mbps + 1, block_size=args.block_size,
+        num_layers=args.num_layers, num_heads=args.num_heads,
+        head_dim=args.d_model // args.num_heads,
+        max_blocks_per_seq=mbps, dtype=jnp.float32)
+    mesh = mesh_lib.build_mesh(jax.devices())
+    n_chips = int(np.prod(mesh.devices.shape))
+    engine = ServeEngine(model, params, kv, mesh=mesh,
+                         max_slots=args.max_slots,
+                         prefill_chunk=args.prefill_chunk)
+
+    requests = [Request(list(map(int, rng.integers(0, args.vocab_size,
+                                                   int(n)))),
+                        args.max_new)
+                for n in prompt_lens]
+
+    # warm both compiled programs OUTSIDE the measured window (compile
+    # time is a startup cost, not a serving latency; bench.py does the
+    # same for its step programs)
+    warm = engine.submit(Request(list(map(
+        int, rng.integers(0, args.vocab_size, 3))), 2))
+    while warm.state != "done":
+        engine.step()
+    for k in engine.time_breakdown:
+        engine.time_breakdown[k] = 0.0
+
+    # open loop: arrival i at t0 + i/rate, submitted when its time comes
+    # whether or not the engine kept up
+    t0 = time.monotonic()
+    arrivals = [t0 + i / args.rate for i in range(args.requests)]
+    pending = list(zip(arrivals, requests))
+    while pending or any(r.state not in ("done", "failed")
+                         for r in requests):
+        now = time.monotonic()
+        while pending and pending[0][0] <= now:
+            engine.submit(pending.pop(0)[1])
+        stats = engine.step()
+        if not stats and pending:
+            wait = max(0.0, pending[0][0] - time.monotonic())
+            if wait > 0:
+                time.sleep(wait)
+                engine.note_idle(wait)
+    wall_s = time.monotonic() - t0
+
+    failed = [r for r in requests if r.state == "failed"]
+    if failed:
+        raise RuntimeError(
+            f"{len(failed)} bench request(s) failed: {failed[0].error}")
+
+    ttft = [r.first_token_time - r.arrival for r in requests]
+    itl = [b - a for r in requests
+           for a, b in zip(r.token_times, r.token_times[1:])]
+    total_tokens = sum(len(r.generated) for r in requests)
+
+    breakdown = dict(engine.time_breakdown)
+    attributed = sum(breakdown.values())
+    unattributed = wall_s - attributed
+    attribution = {
+        "wall_s": round(wall_s, 4),
+        **{f"{k}_s": round(v, 4) for k, v in breakdown.items()},
+        "attributed_s": round(attributed, 4),
+        "unattributed_fraction": round(unattributed / wall_s, 4),
+    }
+    attribution["valid"] = abs(unattributed) <= \
+        ATTRIBUTION_TOLERANCE * wall_s
+
+    result = {
+        "mode": "serve",
+        "devices": n_chips,
+        "requests": args.requests,
+        "rate_rps": args.rate,
+        "max_new_tokens": args.max_new,
+        "prompt_len_mean": float(np.mean(prompt_lens)),
+        "max_slots": args.max_slots,
+        "prefill_chunk": args.prefill_chunk,
+        "kv_block_size": args.block_size,
+        "kv_pool_blocks": kv.num_blocks,
+        "kv_pool_mib": round(kv.pool_bytes() / 2 ** 20, 2),
+        "ttft_ms": _percentiles_ms(ttft),
+        "inter_token_ms": _percentiles_ms(itl),
+        "tokens_generated": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall_s, 2),
+        "tokens_per_sec_per_chip": round(total_tokens / wall_s / n_chips,
+                                         3),
+        "attribution": attribution,
+    }
+    return result
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    result = run_bench(args)
+    print(json.dumps(result, indent=1))
+    if not result["attribution"]["valid"]:
+        explained = 1 - abs(result["attribution"]["unattributed_fraction"])
+        print("SERVE ATTRIBUTION VIOLATED: engine phases + idle explain "
+              f"{explained:.1%} of wall clock (tolerance "
+              f"{ATTRIBUTION_TOLERANCE:.0%}) — a scheduler phase is "
+              "leaking unaccounted time")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1)
+    return 0 if result["attribution"]["valid"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

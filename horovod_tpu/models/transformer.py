@@ -14,6 +14,13 @@ of the TPU framework. Design:
   contexts n× longer than a single chip could hold.
 * Causal masking composes with the ring: block pairs that are entirely
   in the future are still computed (static shapes) but masked.
+* **Incremental decode** (``kv_cache=`` — the serving plane,
+  docs/SERVING.md): feed only the new tokens with their absolute
+  positions plus per-layer cached K/V; attention runs dense over
+  cache ++ new (absolute-position masking makes pad slots exact no-ops)
+  and the new tokens' K/V come back for the caller's paged pool
+  (``horovod_tpu/serve/kvcache.py``). One parameter tree serves both
+  modes — a training checkpoint decodes unchanged.
 """
 
 import dataclasses
@@ -93,7 +100,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, contiguous_positions=False):
+    def __call__(self, x, positions, contiguous_positions=False,
+                 cache=None):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.d_model // cfg.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -101,6 +109,28 @@ class Attention(nn.Module):
         q = _rotary(dense("query")(x), positions)
         k = _rotary(dense("key")(x), positions)
         v = dense("value")(x)
+        if cache is not None:
+            # incremental decode: attend over cached context ++ the new
+            # tokens, and hand the new tokens' (post-rotary) K/V back to
+            # the caller to write into its pool (serve/kvcache.py). Pad
+            # context slots carry a sentinel position larger than any
+            # real one, so the absolute-position causal mask hides them;
+            # masked scores are exactly -inf -> exactly-zero probs, so
+            # padding never perturbs the visible tokens' output. Always
+            # the dense path: decode q_len (1, or one prefill chunk)
+            # sits below the flash kernel's MXU block floor
+            # (ops/flash_attention.kernel_supported routes it out too).
+            ck, cv, ctx_positions = cache
+            k_all = jnp.concatenate([ck.astype(k.dtype), k], axis=1)
+            v_all = jnp.concatenate([cv.astype(v.dtype), v], axis=1)
+            kv_pos = jnp.concatenate([ctx_positions, positions], axis=1)
+            out = dense_attention(q, k_all, v_all, causal=cfg.causal,
+                                  q_positions=positions,
+                                  kv_positions=kv_pos)
+            out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1),
+                                  dtype=cfg.dtype, use_bias=False,
+                                  name="out")(out)
+            return out, (k, v)
         use_flash = cfg.flash_attention
         if use_flash is None:
             # auto: TPU only, and only past the measured seq crossover
@@ -137,11 +167,18 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, contiguous_positions=False):
+    def __call__(self, x, positions, contiguous_positions=False,
+                 cache=None):
         cfg = self.cfg
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
-        x = x + Attention(cfg, name="attn")(y, positions,
-                                            contiguous_positions)
+        new_kv = None
+        if cache is not None:
+            attn_out, new_kv = Attention(cfg, name="attn")(
+                y, positions, contiguous_positions, cache)
+            x = x + attn_out
+        else:
+            x = x + Attention(cfg, name="attn")(y, positions,
+                                                contiguous_positions)
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
         if self.use_moe:
             from horovod_tpu.models.moe import MoE
@@ -157,6 +194,8 @@ class Block(nn.Module):
             y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False)(y)
             y = nn.gelu(y)
             y = nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=False)(y)
+        if cache is not None:
+            return x + y, new_kv
         return x + y
 
 
@@ -170,9 +209,51 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, train: bool = True):
-        del train
+    def __call__(self, tokens, positions=None, train: bool = True,
+                 kv_cache=None):
+        del train  # no dropout in this family: decode needs no RNG
         cfg = self.cfg
+        if kv_cache is not None:
+            # incremental decode (docs/SERVING.md): ``kv_cache`` is
+            # ``(ctx_k, ctx_v, ctx_positions)`` with per-layer context
+            # K/V stacked ``[L, B, S_ctx, H, D]`` and ``ctx_positions``
+            # ``[B, S_ctx]`` int32 absolute positions (pad slots carry a
+            # sentinel past every real position). ``positions`` must be
+            # the fed tokens' absolute positions. Returns
+            # ``(logits, (new_k, new_v))`` — the fed tokens' K/V,
+            # ``[L, B, S_q, H, D]``, for the caller's cache writes. The
+            # same parameter tree drives both modes, so a training
+            # checkpoint serves unchanged.
+            if cfg.sequence_axis is not None:
+                raise ValueError(
+                    "incremental decode composes with a paged cache, not "
+                    "ring attention — build the serving model with "
+                    "sequence_axis=None")
+            if not cfg.causal:
+                raise ValueError("incremental decode requires causal "
+                                 "attention (cfg.causal=True)")
+            if positions is None:
+                raise ValueError(
+                    "incremental decode needs explicit absolute "
+                    "positions for the fed tokens")
+            ctx_k, ctx_v, ctx_positions = kv_cache
+            x = nn.Embed(cfg.vocab_size, cfg.d_model,
+                         dtype=cfg.dtype, name="embed")(tokens)
+            new_ks, new_vs = [], []
+            for i in range(cfg.num_layers):
+                use_moe = (cfg.moe_every > 0
+                           and (i + 1) % cfg.moe_every == 0)
+                x, (nk, nv) = Block(cfg, use_moe=use_moe,
+                                    name=f"block_{i}")(
+                    x, positions, False,
+                    (ctx_k[i], ctx_v[i], ctx_positions))
+                new_ks.append(nk)
+                new_vs.append(nv)
+            x = nn.RMSNorm(dtype=cfg.dtype)(x)
+            logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                              use_bias=False, name="lm_head")(x)
+            return (logits.astype(jnp.float32),
+                    (jnp.stack(new_ks), jnp.stack(new_vs)))
         contiguous = positions is None  # auto positions are 0..S-1
         if positions is None:
             from horovod_tpu.parallel.ring import default_positions

@@ -419,6 +419,14 @@ def kernel_supported(sq, skv, d, block_q=DEFAULT_BLOCK_Q,
     fall back to the plain-XLA path)."""
     if pltpu is None:
         return False
+    # incremental-decode shapes (q_len == 1 — one new token per sequence
+    # against a long cached K/V, the serve/engine.py hot loop) can never
+    # tile onto an MXU-floor block: route them to the dense path
+    # EXPLICITLY rather than relying on the block fit to bottom out —
+    # the contract a decode caller depends on deserves its own gate
+    # (and its own test), not an emergent property of _fit_block
+    if sq == 1 or skv == 1:
+        return False
     # blocks must respect the fp32 sublane tile (8) or Mosaic can
     # reject the lowering — the fallback contract depends on this gate —
     # and clear the MXU floor, or the dense fallback is faster

@@ -300,6 +300,47 @@ def collective_bytes_from_hlo(hlo_text):
     return out
 
 
+class CompiledProgramCache:
+    """Shape-signature-keyed AOT executable cache: ONE
+    ``lower().compile()`` per (jitted program, argument shape/dtype
+    signature), each compiled module's collectives accounted exactly
+    once via :func:`record_compiled_collectives` under ``<prefix>_*``
+    labels, and the executable returned for DIRECT calls (on this jax
+    an AOT compile does not populate the jit dispatch cache, so
+    dispatching through the wrapper after compiling would build the
+    identical module twice). The ONE copy of this machinery — the GSPMD
+    training scaffold (``training._SpmdProgram``) and the serving
+    engine (``serve/engine.py``) both wrap it, so a fix to the key or
+    the accounting semantics cannot miss a site."""
+
+    def __init__(self, prefix="spmd"):
+        self.prefix = prefix
+        self._programs = {}  # signature -> (executable, collectives)
+        self.last_collectives = None
+
+    @staticmethod
+    def signature(args):
+        import jax.numpy as jnp
+
+        return tuple((tuple(jnp.shape(x)), str(jnp.result_type(x)))
+                     for x in jax.tree_util.tree_leaves(args))
+
+    def executable(self, jitted, args):
+        key = self.signature(args)
+        entry = self._programs.get(key)
+        if entry is None:
+            compiled = jitted.lower(*args).compile()
+            try:
+                collectives = record_compiled_collectives(
+                    compiled, prefix=self.prefix)
+            except Exception:  # pragma: no cover — must not kill a step
+                collectives = {}
+            entry = (compiled, collectives)
+            self._programs[key] = entry
+        self.last_collectives = entry[1]
+        return entry[0]
+
+
 def record_compiled_collectives(compiled, prefix="spmd"):
     """Account one compiled step's collectives into the standard
     telemetry families (``hvd_collective_{calls,bytes,logical_bytes}

@@ -1,0 +1,52 @@
+"""The serving plane: continuous-batching inference straight from
+sharded checkpoints (docs/SERVING.md).
+
+Ten PRs of this framework train, checkpoint, reshard and attribute —
+this package is what finally ANSWERS A REQUEST. The pieces compose from
+what already exists rather than duplicating it:
+
+* **weights** (``loader.py``) — a ``ckpt/`` MANIFEST loads params-only
+  onto the inference mesh (ZeRO rows skipped; the N→M world-independent
+  assembly of PR 9), and a :class:`ReloadWatcher` rolls newer
+  checkpoints into the live engine without dropping traffic;
+* **memory** (``kvcache.py``) — a paged KV pool (fixed-size blocks,
+  per-sequence block tables, host-side allocator): cache memory scales
+  with live tokens, not ``max_seq × max_batch``;
+* **compute** (``engine.py``) — iteration-level continuous batching
+  over two static-shaped AOT-compiled programs (chunked prefill +
+  batched decode) on a ``GspmdPlan`` mesh, greedy sampling, per-request
+  token streams;
+* **frontend** (``server.py`` + ``cli.py``/``bin/hvd-serve``) — a
+  streaming ``/generate`` endpoint on the shared stdlib HTTP
+  scaffolding, ``/healthz`` + ``/metrics`` alongside, with the
+  ``hvd_serve_*`` instrument family in the standard registry.
+
+``bench_serve.py`` (repo root) is the load harness: p50/p99
+time-to-first-token, inter-token latency, tokens/sec/chip under an
+open-loop arrival schedule, with a goodput-style prefill/decode/idle
+time-attribution block.
+"""
+
+from horovod_tpu.serve.engine import (  # noqa: F401
+    Request,
+    RequestError,
+    ServeEngine,
+)
+from horovod_tpu.serve.kvcache import (  # noqa: F401
+    BlockAllocator,
+    KVCacheConfig,
+    init_pool,
+)
+from horovod_tpu.serve.loader import (  # noqa: F401
+    ReloadWatcher,
+    abstract_params,
+    load_params,
+)
+from horovod_tpu.serve.server import ServeServer  # noqa: F401
+
+__all__ = [
+    "ServeEngine", "Request", "RequestError",
+    "KVCacheConfig", "BlockAllocator", "init_pool",
+    "load_params", "abstract_params", "ReloadWatcher",
+    "ServeServer",
+]

@@ -1,0 +1,165 @@
+"""``hvd-serve`` — serve a checkpointed transformer over HTTP.
+
+    hvd-serve --ckpt-dir /ckpts --port 8000 \\
+        --num-layers 4 --num-heads 8 --d-model 512 --d-ff 2048
+
+Loads the newest manifest-complete checkpoint's params straight onto
+the local inference mesh (N-host training world → M-device serving
+mesh, no conversion step), starts the continuous-batching engine and
+the streaming frontend, and keeps polling the checkpoint dir for newer
+manifests — a training job committing checkpoints into the same
+directory rolls new weights into serving without a restart
+(docs/SERVING.md).
+
+The model architecture is not recorded in the checkpoint (params are a
+plain tree), so the flags must restate it. A manifest whose ``meta``
+carries a ``model_config`` dict (anything the trainer chose to record
+via ``save_sharded(meta=...)``) is cross-checked against the flags and
+mismatches fail loudly instead of serving garbage.
+"""
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="hvd-serve",
+        description="continuous-batching inference server fed from "
+                    "horovod_tpu sharded checkpoints")
+    p.add_argument("--ckpt-dir", required=True,
+                   help="checkpoint root (ckpt-<step>/ dirs with "
+                        "MANIFEST.json)")
+    p.add_argument("--step", type=int, default=None,
+                   help="serve this exact step (default: newest "
+                        "complete, with validation fallback)")
+    p.add_argument("--addr", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    # model architecture (must match the checkpoint)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--num-layers", type=int, default=4)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--d-ff", type=int, default=2048)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=("bfloat16", "float32"))
+    # serving shape
+    p.add_argument("--max-slots", type=int, default=8,
+                   help="decode batch width (multiples of the device "
+                        "count shard the batch over the mesh)")
+    p.add_argument("--prefill-chunk", type=int, default=256)
+    p.add_argument("--block-size", type=int, default=16,
+                   help="KV tokens per pool block")
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="KV pool blocks incl. the null block "
+                        "(default: max_slots * max_blocks_per_seq + 1)")
+    p.add_argument("--max-seq-len", type=int, default=2048,
+                   help="longest prompt+generation a request may map")
+    p.add_argument("--reload-poll-seconds", type=float, default=5.0)
+    p.add_argument("--no-reload", action="store_true",
+                   help="serve the startup checkpoint forever")
+    return p
+
+
+def _check_meta(meta, args):
+    """Fail loudly when the manifest records an architecture that
+    contradicts the flags (best effort: trainers opt in via meta)."""
+    mc = (meta or {}).get("model_config")
+    if not isinstance(mc, dict):
+        return
+    flags = {"vocab_size": args.vocab_size, "num_layers": args.num_layers,
+             "num_heads": args.num_heads, "d_model": args.d_model,
+             "d_ff": args.d_ff}
+    bad = {k: (mc[k], v) for k, v in flags.items()
+           if k in mc and int(mc[k]) != int(v)}
+    if bad:
+        raise SystemExit(
+            f"hvd-serve: checkpoint manifest records model_config "
+            f"{ {k: a for k, (a, _) in bad.items()} }, flags say "
+            f"{ {k: b for k, (_, b) in bad.items()} } — refusing to "
+            "serve a mismatched architecture")
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s: %(message)s")
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+    from horovod_tpu.parallel import mesh as mesh_lib
+    from horovod_tpu.serve import engine as engine_lib
+    from horovod_tpu.serve import kvcache, loader
+    from horovod_tpu.serve.server import ServeServer
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    cfg = TransformerConfig(
+        vocab_size=args.vocab_size, num_layers=args.num_layers,
+        num_heads=args.num_heads, d_model=args.d_model, d_ff=args.d_ff,
+        dtype=dtype, causal=True)
+    model = Transformer(cfg)
+
+    target = loader.abstract_params(model, seq_len=8)
+    step, params, meta = loader.load_params(args.ckpt_dir, target,
+                                            step=args.step)
+    _check_meta(meta, args)
+    logger.info("hvd-serve: loaded params of ckpt step %d from %s",
+                step, args.ckpt_dir)
+
+    mbps = -(-args.max_seq_len // args.block_size)
+    num_blocks = (args.num_blocks if args.num_blocks is not None
+                  else args.max_slots * mbps + 1)
+    kv = kvcache.KVCacheConfig(
+        num_blocks=num_blocks, block_size=args.block_size,
+        num_layers=args.num_layers, num_heads=args.num_heads,
+        head_dim=args.d_model // args.num_heads,
+        max_blocks_per_seq=mbps, dtype=dtype)
+    logger.info("hvd-serve: KV pool %d blocks x %d tokens (%.1f MiB)",
+                num_blocks, args.block_size, kv.pool_bytes() / 2 ** 20)
+
+    mesh = mesh_lib.build_mesh(jax.devices())
+    eng = engine_lib.ServeEngine(
+        model, params, kv, mesh=mesh, max_slots=args.max_slots,
+        prefill_chunk=args.prefill_chunk, weights_version=step)
+    eng.start()
+
+    watcher = None
+    if not args.no_reload:
+        watcher = loader.ReloadWatcher(args.ckpt_dir, eng, target,
+                                       poll_s=args.reload_poll_seconds)
+        watcher.mark_current(step)
+        watcher.start()
+
+    server = ServeServer(eng, addr=args.addr, port=args.port)
+    server.start()  # a taken --port is fatal: let the OSError surface
+    logger.info("hvd-serve: ready on http://%s:%d (weights step %d, "
+                "%d devices)", args.addr, server.port, step,
+                len(jax.devices()))
+
+    done = threading.Event()
+
+    def _sig(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        done.wait()
+    finally:
+        server.stop()
+        if watcher is not None:
+            watcher.stop()
+        eng.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,569 @@
+"""Continuous-batching inference engine.
+
+The serving analogue of ``training.make_train_step``: two static-shaped
+jitted programs — **prefill** (one chunk of one sequence's prompt) and
+**decode** (one new token for every running sequence) — driven by an
+iteration-level scheduler (Orca's continuous batching): requests join
+and leave the running decode batch **between** program dispatches, never
+inside one, so a long generation no longer holds the batch hostage and
+a short one no longer waits for it.
+
+One scheduler iteration (:meth:`ServeEngine.step`):
+
+1. **weight swap** — staged params from the rolling-reload watcher
+   (``serve/loader.py``) replace the live tree; in-flight sequences keep
+   their KV and continue under the new weights (docs/SERVING.md,
+   "Rolling reload").
+2. **admission** — FIFO from the waiting queue into free batch slots,
+   all-or-nothing reserving ``ceil((prompt + max_new) / block_size)``
+   KV blocks, so a running sequence can never die of pool exhaustion;
+   a queue head that cannot get its reservation waits (KV
+   backpressure).
+3. **prefill** — ONE chunk of the longest-waiting prefilling request.
+   Chunked prefill bounds how long a huge prompt can starve the decode
+   batch: decode advances every iteration regardless.
+4. **decode** — one token for every sequence in the decode state, in
+   one batched dispatch; finished sequences (``max_new_tokens`` / EOS)
+   are retired and their blocks return to the pool.
+
+Placement rides a :class:`~horovod_tpu.parallel.gspmd.GspmdPlan`
+inference mesh: params and the KV pool replicated, the decode batch
+sharded over the data axes when the slot count divides the world. Both
+programs go through the PR-9 AOT machinery — one ``lower().compile()``
+per shape signature, compiled-HLO collective accounting under
+``serve_*`` labels, executables called directly.
+
+Sampling is greedy (argmax) — deterministic, which is what makes
+"continuous-batched decode is bit-identical to a single-shot decode"
+a testable contract (tests/test_serve.py).
+"""
+
+import itertools
+import logging
+import queue
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import gspmd as gspmd_lib
+from horovod_tpu.parallel import mesh as mesh_lib
+from horovod_tpu.serve import kvcache
+from horovod_tpu.telemetry import instruments as instruments_lib
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class RequestError(RuntimeError):
+    """A generate request failed (invalid, or the engine stopped)."""
+
+
+class Request:
+    """One generate request and its token stream.
+
+    The engine appends events to a thread-safe queue as it produces
+    them; :meth:`stream` (the HTTP handler's read side, and the test
+    harness's) yields token ids until the terminal ``done``/``error``
+    event. Timing fields (``arrival``, ``first_token_time``,
+    ``token_times``) are stamped with the ENGINE's clock so fake-clock
+    tests and the bench read one consistent timeline."""
+
+    _ids = itertools.count()
+
+    def __init__(self, tokens, max_new_tokens, eos_id=None,
+                 request_id=None):
+        self.id = (next(self._ids) if request_id is None
+                   else request_id)
+        self.prompt = [int(t) for t in tokens]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.generated = []
+        self.state = "new"  # new|queued|prefill|decode|done|failed
+        self.finish_reason = None
+        self.error = None
+        self.slot = None
+        self.blocks = None
+        self.prefilled = 0  # prompt tokens whose KV is in the pool
+        self.arrival = None
+        self.first_token_time = None
+        self.token_times = []
+        self._events = queue.Queue()
+
+    def _emit(self, kind, value=None):
+        self._events.put((kind, value))
+
+    def stream(self, timeout=120.0):
+        """Yield generated token ids as they arrive. Raises
+        :class:`RequestError` when the request failed, ``TimeoutError``
+        when the engine goes silent for ``timeout`` seconds."""
+        while True:
+            try:
+                kind, value = self._events.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"request {self.id}: no event for {timeout:.0f}s "
+                    f"(state {self.state})") from None
+            if kind == "token":
+                yield value
+            elif kind == "done":
+                return
+            else:
+                raise RequestError(value)
+
+    def result(self, timeout=120.0):
+        """Drain the stream; returns the full generated token list."""
+        return list(self.stream(timeout=timeout))
+
+
+class _AotProgram:
+    """One serving program bound to the shared PR-9 AOT machinery
+    (``gspmd.CompiledProgramCache``): serving shapes are static, so
+    each program is exactly one ``lower().compile()``, its collectives
+    accounted once under ``serve_*`` op labels, the executable called
+    directly on every iteration."""
+
+    def __init__(self, jitted):
+        self._jitted = jitted
+        self._cache = gspmd_lib.CompiledProgramCache(prefix="serve")
+
+    def __call__(self, *args):
+        return self._cache.executable(self._jitted, args)(*args)
+
+
+class ServeEngine:
+    """Continuous-batching scheduler over one model + paged KV pool.
+
+    ``max_slots`` is the decode batch width (static — inactive slots
+    are masked); ``prefill_chunk`` the per-iteration prompt chunk.
+    ``clock`` is injectable for deterministic scheduler tests. Drive it
+    either with :meth:`start`/:meth:`stop` (background thread — the
+    HTTP frontend's mode) or by calling :meth:`step` yourself (the
+    bench's and the fake-clock tests' mode)."""
+
+    def __init__(self, model, params, kv_config, mesh=None, max_slots=4,
+                 prefill_chunk=16, clock=time.monotonic, registry=None,
+                 weights_version=None):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self._model = model
+        self._kv = kv_config
+        self._clock = clock
+        self.max_slots = int(max_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        if mesh is None:
+            try:
+                mesh = mesh_lib.get_mesh()
+            except RuntimeError:
+                mesh = mesh_lib.build_mesh(jax.devices())
+        self.plan = gspmd_lib.derive_plan(mesh)
+        world = self.plan.world()
+        self._rep = self.plan.sharding(P())
+        if self.max_slots % world == 0:
+            batch_spec = self.plan.batch_spec
+        else:
+            # an indivisible slot count replicates the decode batch —
+            # correct everywhere, parallel nowhere; say so once
+            logger.info(
+                "serve: max_slots=%d does not divide the %d-way data "
+                "mesh — decode batch replicated (pick a multiple for "
+                "batch sharding)", self.max_slots, world)
+            batch_spec = P()
+        self._batch_sharding = self.plan.sharding(batch_spec)
+
+        self.instruments = instruments_lib.serve_instruments(registry)
+        self.allocator = kvcache.BlockAllocator(kv_config.num_blocks)
+        # per-slot scheduler state (host): block table rows, cached-token
+        # counts, last sampled token — the mirror of what the device
+        # programs consume each iteration
+        self._tables = np.zeros(
+            (self.max_slots, kv_config.max_blocks_per_seq), np.int32)
+        self._lengths = np.zeros((self.max_slots,), np.int32)
+        self._last_token = np.zeros((self.max_slots,), np.int32)
+        self._slots = [None] * self.max_slots
+        self._waiting = deque()
+
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._staged = None  # (placed params, version) awaiting swap
+        self.weights_version = weights_version
+        self._stop = threading.Event()
+        self._thread = None
+        self._broken = None  # fatal engine error (donated pool lost)
+
+        # run-level time attribution (bench_serve.py validates the sum
+        # against wall clock, goodput-ledger style)
+        self.time_breakdown = {"prefill": 0.0, "decode": 0.0,
+                               "overhead": 0.0, "idle": 0.0}
+
+        self._params = jax.device_put(params, self._rep)
+        self._pool = jax.device_put(kvcache.init_pool(kv_config),
+                                    self._rep)
+        self._build_programs()
+
+    # -- the two compiled programs -----------------------------------------
+    def _build_programs(self):
+        model, kv = self._model, self._kv
+        max_context = kv.max_context
+
+        def decode_fn(params, pool, tokens, lengths, tables):
+            # one new token per slot; slots with lengths == 0 are
+            # inactive — their writes go to the null block and their
+            # sampled token is ignored by the host
+            active = lengths > 0
+            ctx_k, ctx_v = kvcache.gather_context(pool, tables)
+            cpos = kvcache.context_positions(lengths, max_context)
+            logits, (nk, nv) = model.apply(
+                {"params": params}, tokens[:, None],
+                positions=lengths[:, None], train=False,
+                kv_cache=(ctx_k, ctx_v, cpos))
+            pool2 = kvcache.write_tokens(pool, tables, lengths, nk, nv,
+                                         mask=active[:, None])
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return nxt, pool2
+
+        def prefill_fn(params, pool, tokens, start, total, table):
+            # one chunk of one sequence: tokens [1, C] (pad past the
+            # prompt), absolute positions start..start+C-1; context =
+            # the sequence's own already-prefilled tokens. Returns the
+            # greedily sampled successor of the LAST PROMPT token —
+            # meaningful only on the final chunk (the host knows which).
+            c = tokens.shape[1]
+            positions = (start + jnp.arange(c, dtype=jnp.int32))[None, :]
+            valid = positions < total
+            ctx_k, ctx_v = kvcache.gather_context(pool, table)
+            cpos = kvcache.context_positions(
+                jnp.reshape(start, (1,)), max_context)
+            logits, (nk, nv) = model.apply(
+                {"params": params}, tokens, positions=positions,
+                train=False, kv_cache=(ctx_k, ctx_v, cpos))
+            pool2 = kvcache.write_tokens(pool, table,
+                                         jnp.reshape(start, (1,)),
+                                         nk, nv, mask=valid)
+            last = jnp.clip(total - 1 - start, 0, c - 1)
+            last_logits = jax.lax.dynamic_index_in_dim(
+                logits[0], last, axis=0, keepdims=False)
+            nxt = jnp.argmax(last_logits).astype(jnp.int32)
+            return nxt, pool2
+
+        rep, bsh = self._rep, self._batch_sharding
+        # the pool is donated: it is the one big buffer, and decode runs
+        # every iteration — without donation the pool would be double-
+        # buffered across every dispatch
+        self._decode = _AotProgram(jax.jit(
+            decode_fn,
+            in_shardings=(rep, rep, bsh, bsh, bsh),
+            out_shardings=(rep, rep),
+            donate_argnums=(1,)))
+        self._prefill = _AotProgram(jax.jit(
+            prefill_fn,
+            in_shardings=(rep, rep, rep, rep, rep, rep),
+            out_shardings=(rep, rep),
+            donate_argnums=(1,)))
+
+    def _place_batch(self, x):
+        return jax.device_put(np.asarray(x), self._batch_sharding)
+
+    def _place_rep(self, x):
+        return jax.device_put(np.asarray(x), self._rep)
+
+    # -- request intake ------------------------------------------------------
+    def submit(self, request):
+        """Queue a request; returns it. Invalid requests (empty prompt,
+        or a reservation no pool state could ever satisfy) fail
+        immediately — loudly to the caller AND on the request's own
+        stream."""
+        kv = self._kv
+        with self._work:
+            request.arrival = self._clock()
+            err = None
+            if self._stop.is_set() or self._broken is not None:
+                err = "serve engine is stopped"
+            elif not request.prompt:
+                err = "empty prompt"
+            elif request.max_new_tokens < 1:
+                err = "max_new_tokens must be >= 1"
+            else:
+                need = kv.blocks_for(len(request.prompt)
+                                     + request.max_new_tokens)
+                if (need > kv.max_blocks_per_seq
+                        or need > self.allocator.capacity):
+                    err = (f"request needs {need} KV blocks "
+                           f"({len(request.prompt)} prompt + "
+                           f"{request.max_new_tokens} new tokens), the "
+                           f"pool allows min(max_blocks_per_seq="
+                           f"{kv.max_blocks_per_seq}, capacity="
+                           f"{self.allocator.capacity})")
+            if err is not None:
+                self._fail(request, err)
+                raise RequestError(err)
+            request.state = "queued"
+            self._waiting.append(request)
+            self.instruments.submitted.inc()
+            self.instruments.queue_depth.set(len(self._waiting))
+            self._work.notify_all()
+        return request
+
+    def generate(self, tokens, max_new_tokens, eos_id=None):
+        """Convenience: build + submit, returns the :class:`Request`."""
+        return self.submit(Request(tokens, max_new_tokens, eos_id=eos_id))
+
+    # -- rolling weight reload ----------------------------------------------
+    def install_weights(self, params, version=None):
+        """Stage a new replicated parameter tree; the swap happens at
+        the top of the next scheduler iteration — never inside a
+        dispatch — so in-flight requests see a clean cut: tokens up to
+        the swap from the old weights, tokens after it from the new,
+        KV cache carried over (docs/SERVING.md, "Rolling reload")."""
+        placed = jax.device_put(params, self._rep)
+        with self._work:
+            self._staged = (placed, version)
+            self._work.notify_all()
+
+    def _apply_staged_weights(self):
+        if self._staged is not None:
+            self._params, self.weights_version = self._staged
+            self._staged = None
+            logger.info("serve: weights swapped in (version %s), "
+                        "%d request(s) in flight",
+                        self.weights_version, self.active_count)
+            return True
+        return False
+
+    # -- scheduler -----------------------------------------------------------
+    def step(self):
+        """One scheduler iteration; returns a stats dict (empty/falsy
+        when there was nothing to do)."""
+        if self._broken is not None:
+            raise RuntimeError(
+                "serve engine is broken (a dispatch failed after the "
+                "pool was donated)") from self._broken
+        t0 = self._clock()
+        with self._lock:
+            swapped = self._apply_staged_weights()
+            admitted = self._admit()
+            prefill_req = min(
+                (r for r in self._slots
+                 if r is not None and r.state == "prefill"),
+                key=lambda r: (r.arrival, r.id), default=None)
+            decoding = [i for i, r in enumerate(self._slots)
+                        if r is not None and r.state == "decode"]
+        stats = {}
+        compute_s = 0.0
+        if swapped:
+            stats["swapped"] = True
+        if admitted:
+            stats["admitted"] = len(admitted)
+        try:
+            if prefill_req is not None:
+                t = self._clock()
+                self._prefill_step(prefill_req)
+                dt = self._clock() - t
+                self.time_breakdown["prefill"] += dt
+                compute_s += dt
+                stats["prefilled"] = prefill_req.id
+            if decoding:
+                t = self._clock()
+                self._decode_step(decoding)
+                dt = self._clock() - t
+                self.time_breakdown["decode"] += dt
+                compute_s += dt
+                stats["decoded"] = len(decoding)
+        except Exception as e:
+            # the pool was donated into the failed dispatch — the engine
+            # cannot continue; fail every live request so clients unblock
+            self._broken = e
+            with self._lock:
+                for r in list(self._slots) + list(self._waiting):
+                    if r is not None and r.state not in ("done", "failed"):
+                        self._fail(r, f"engine dispatch failed: {e}")
+                self._waiting.clear()
+            raise
+        # whatever the iteration spent outside the two dispatches
+        # (admission, bookkeeping, streaming) is scheduler overhead —
+        # every second of a serving run lands in exactly one phase
+        self.time_breakdown["overhead"] += max(
+            0.0, self._clock() - t0 - compute_s)
+        return stats
+
+    def note_idle(self, seconds):
+        """Attribute wait-for-work time (the run loop's, or the
+        bench's open-loop sleeps) to the idle phase."""
+        self.time_breakdown["idle"] += max(0.0, float(seconds))
+
+    def _admit(self):
+        admitted = []
+        while self._waiting:
+            req = self._waiting[0]
+            free = next((i for i, r in enumerate(self._slots)
+                         if r is None), None)
+            if free is None:
+                break
+            need = self._kv.blocks_for(len(req.prompt)
+                                       + req.max_new_tokens)
+            blocks = self.allocator.alloc(need)
+            if blocks is None:
+                break  # FIFO head backpressured on KV blocks
+            self._waiting.popleft()
+            req.slot, req.blocks = free, blocks
+            req.state = "prefill"
+            req.prefilled = 0
+            self._slots[free] = req
+            row = np.zeros((self._kv.max_blocks_per_seq,), np.int32)
+            row[:len(blocks)] = blocks
+            self._tables[free] = row
+            self._lengths[free] = 0
+            self._last_token[free] = 0
+            admitted.append(req)
+        self.instruments.queue_depth.set(len(self._waiting))
+        self.instruments.kv_blocks.set(self.allocator.in_use)
+        return admitted
+
+    def _prefill_step(self, req):
+        start = req.prefilled
+        c = self.prefill_chunk
+        chunk = req.prompt[start:start + c]
+        tokens = np.zeros((1, c), np.int32)
+        tokens[0, :len(chunk)] = chunk
+        nxt, self._pool = self._prefill(
+            self._params, self._pool, self._place_rep(tokens),
+            self._place_rep(np.int32(start)),
+            self._place_rep(np.int32(len(req.prompt))),
+            self._place_rep(self._tables[req.slot:req.slot + 1]))
+        req.prefilled = min(start + c, len(req.prompt))
+        self._lengths[req.slot] = req.prefilled
+        if req.prefilled >= len(req.prompt):
+            # final chunk: the last prompt token's logits yield the
+            # first generated token — TTFT stops here
+            tok = int(jax.device_get(nxt))
+            req.state = "decode"
+            self._last_token[req.slot] = tok
+            self._append_token(req, tok, self._clock())
+
+    def _decode_step(self, decoding):
+        active = np.zeros((self.max_slots,), bool)
+        active[decoding] = True
+        lengths = np.where(active, self._lengths, 0).astype(np.int32)
+        nxt, self._pool = self._decode(
+            self._params, self._pool,
+            self._place_batch(self._last_token),
+            self._place_batch(lengths),
+            self._place_batch(self._tables))
+        nxt = np.asarray(jax.device_get(nxt))
+        now = self._clock()
+        for i in decoding:
+            req = self._slots[i]
+            self._lengths[i] += 1  # the fed token's KV is now cached
+            tok = int(nxt[i])
+            self._last_token[i] = tok
+            self._append_token(req, tok, now)
+
+    def _append_token(self, req, tok, now):
+        req.generated.append(tok)
+        req.token_times.append(now)
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.instruments.ttft_seconds.observe(now - req.arrival)
+        else:
+            self.instruments.inter_token_seconds.observe(
+                now - req.token_times[-2])
+        self.instruments.tokens.inc()
+        req._emit("token", tok)
+        if req.eos_id is not None and tok == req.eos_id:
+            self._retire(req, "eos")
+        elif len(req.generated) >= req.max_new_tokens:
+            self._retire(req, "length")
+
+    def _retire(self, req, reason):
+        with self._work:
+            self.allocator.free(req.blocks)
+            self._slots[req.slot] = None
+            self._tables[req.slot] = 0
+            self._lengths[req.slot] = 0
+            self._last_token[req.slot] = 0
+            req.blocks = None
+            req.state = "done"
+            req.finish_reason = reason
+            self.instruments.completed.inc()
+            self.instruments.kv_blocks.set(self.allocator.in_use)
+            req._emit("done")
+            self._work.notify_all()  # blocks freed: admission may proceed
+
+    def _fail(self, req, message):
+        req.state = "failed"
+        req.error = message
+        self.instruments.failed.inc()
+        req._emit("error", message)
+
+    # -- run loop -------------------------------------------------------------
+    @property
+    def active_count(self):
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def queue_depth(self):
+        return len(self._waiting)
+
+    def _has_work_locked(self):
+        if self._staged is not None:
+            return True
+        if any(r is not None for r in self._slots):
+            return True
+        # a waiting request counts as work only if admission could
+        # succeed — a backpressured head must not busy-spin
+        if self._waiting:
+            req = self._waiting[0]
+            need = self._kv.blocks_for(len(req.prompt)
+                                       + req.max_new_tokens)
+            return (any(r is None for r in self._slots)
+                    and need <= self.allocator.available)
+        return False
+
+    def _loop(self):
+        while not self._stop.is_set():
+            stats = self.step()
+            if not stats:
+                with self._work:
+                    if self._stop.is_set() or self._has_work_locked():
+                        continue
+                    t = self._clock()
+                    self._work.wait(timeout=0.05)
+                    self.note_idle(self._clock() - t)
+
+    def start(self):
+        """Run the scheduler on a background thread (the HTTP
+        frontend's mode)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hvd_serve_engine",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the scheduler; queued and in-flight requests fail with
+        "engine stopped" so no client blocks forever."""
+        self._stop.set()
+        with self._work:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        with self._work:
+            for req in list(self._waiting) + [
+                    r for r in self._slots if r is not None]:
+                if req.state not in ("done", "failed"):
+                    if req.blocks:
+                        self.allocator.free(req.blocks)
+                        req.blocks = None
+                    if req.slot is not None:
+                        self._slots[req.slot] = None
+                    self._fail(req, "serve engine stopped")
+            self._waiting.clear()

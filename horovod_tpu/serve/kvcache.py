@@ -1,0 +1,172 @@
+"""Paged KV cache: a block pool + per-sequence block tables.
+
+The serving-memory problem (docs/SERVING.md): a dense per-slot cache
+costs ``max_batch × max_seq_len`` KV slots whether or not a sequence
+uses them — at production batch sizes HBM fills with padding. The
+established fix (vLLM's PagedAttention) is virtual memory for KV: one
+global pool of fixed-size **blocks** (``block_size`` tokens each), a
+per-sequence **block table** mapping its logical token positions onto
+pool blocks, and a host-side allocator handing blocks out on admission
+and reclaiming them on eviction. Pool memory then scales with **live
+tokens**, not ``max_seq_len × max_batch``; fragmentation is bounded by
+one partial block per sequence.
+
+Layout: ``pool["k"]``/``pool["v"]`` are ``[L, N_blocks, block_size, H,
+D]`` device arrays (one stacked allocation per tensor — layers index
+dim 0, so the whole cache is two arrays however deep the model).
+**Block 0 is the null block**: the allocator never hands it out, pad
+writes are routed into it, and inactive batch slots' tables point at it
+— gathered garbage is masked out by the position sentinel
+(:data:`PAD_POSITION`, larger than any real position, so the
+absolute-position causal mask in ``models/transformer.py`` zeroes it
+exactly).
+
+Everything device-side here is a pure function over arrays —
+``serve/engine.py`` composes them inside its jitted prefill/decode
+programs; only :class:`BlockAllocator` is host state.
+"""
+
+import dataclasses
+from collections import deque
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+# larger than any real token position: a context slot carrying this
+# position is in every query's "future" and masks to exactly -inf
+PAD_POSITION = np.int32(2 ** 30)
+NULL_BLOCK = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static shape of the pool. ``num_blocks`` INCLUDES the reserved
+    null block, so usable capacity is ``num_blocks - 1`` blocks."""
+
+    num_blocks: int
+    block_size: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    max_blocks_per_seq: int
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved null block)")
+        if self.max_blocks_per_seq < 1:
+            raise ValueError("max_blocks_per_seq must be >= 1")
+
+    @property
+    def max_context(self):
+        """Longest sequence (prompt + generated) a block table can map."""
+        return self.max_blocks_per_seq * self.block_size
+
+    def blocks_for(self, num_tokens):
+        """Blocks needed to hold ``num_tokens`` cached tokens."""
+        return -(-int(num_tokens) // self.block_size)
+
+    def pool_bytes(self):
+        """K+V pool bytes — the paged-KV sizing math of docs/SERVING.md."""
+        per_slot = self.num_heads * self.head_dim * \
+            jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.num_blocks * self.block_size * \
+            per_slot
+
+
+def init_pool(cfg):
+    shape = (cfg.num_layers, cfg.num_blocks, cfg.block_size,
+             cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def gather_context(pool, block_table):
+    """Materialize the cached context of each sequence for attention:
+    ``block_table`` ``[B, max_blocks_per_seq]`` int32 →
+    ``(k, v)`` each ``[L, B, max_context, H, D]``. Pool slots behind pad
+    table entries (the null block) come back as garbage — the position
+    sentinel from :func:`context_positions` masks them exactly."""
+    k = pool["k"][:, block_table]   # [L, B, mbps, bs, H, D]
+    v = pool["v"][:, block_table]
+    L, B = k.shape[0], k.shape[1]
+    h, d = k.shape[-2], k.shape[-1]
+    return k.reshape(L, B, -1, h, d), v.reshape(L, B, -1, h, d)
+
+
+def context_positions(lengths, max_context):
+    """``[B, max_context]`` absolute positions of the gathered context:
+    slot ``j`` of a sequence with ``lengths[i]`` cached tokens holds
+    token ``j`` (blocks fill in order), so positions are ``0..len-1``
+    and :data:`PAD_POSITION` beyond."""
+    pos = jnp.arange(max_context, dtype=jnp.int32)[None, :]
+    return jnp.where(pos < lengths[:, None], pos,
+                     jnp.int32(PAD_POSITION))
+
+
+def write_tokens(pool, block_table, start, new_k, new_v, mask=None):
+    """Scatter freshly computed K/V into the pool.
+
+    ``new_k``/``new_v`` are ``[L, B, S_q, H, D]`` (the transformer's
+    incremental-decode output); token ``t`` of sequence ``i`` lands at
+    absolute position ``p = start[i] + t`` → pool slot
+    ``(block_table[i, p // block_size], p % block_size)``. ``mask``
+    ``[B, S_q]`` (False = pad token / inactive slot) routes masked
+    writes into the null block — the pool write stays static-shaped and
+    the garbage is invisible by construction. Returns the new pool."""
+    bs = pool["k"].shape[2]
+    mbps = block_table.shape[1]
+    S = new_k.shape[2]
+    p = start[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # [B,S]
+    # clip before the table lookup: a masked position may point past the
+    # table (it is about to be routed to the null block anyway)
+    blk = jnp.take_along_axis(block_table,
+                              jnp.clip(p // bs, 0, mbps - 1), axis=1)
+    off = p % bs
+    if mask is not None:
+        blk = jnp.where(mask, blk, NULL_BLOCK)
+        off = jnp.where(mask, off, 0)
+    return {"k": pool["k"].at[:, blk, off].set(new_k),
+            "v": pool["v"].at[:, blk, off].set(new_v)}
+
+
+class BlockAllocator:
+    """Host-side free list over pool blocks ``1..num_blocks-1``.
+
+    ``alloc`` is all-or-nothing — a request that cannot get its full
+    reservation gets ``None`` and stays queued (the engine's KV
+    backpressure); ``free`` returns an eviction's blocks to the pool.
+    Not thread-safe by itself: the engine mutates it only under its
+    scheduler lock."""
+
+    def __init__(self, num_blocks):
+        self.capacity = int(num_blocks) - 1
+        self._free = deque(range(1, int(num_blocks)))
+        self._out = set()
+
+    @property
+    def available(self):
+        return len(self._free)
+
+    @property
+    def in_use(self):
+        return len(self._out)
+
+    def alloc(self, n):
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.popleft() for _ in range(n)]
+        self._out.update(blocks)
+        return blocks
+
+    def free(self, blocks):
+        for b in blocks:
+            if b not in self._out:
+                raise ValueError(
+                    f"double free of KV block {b} (allocated: no)")
+            self._out.discard(b)
+            self._free.append(b)

@@ -1,0 +1,217 @@
+"""Serve-side weight loading: ckpt manifest → inference mesh.
+
+A training checkpoint is a sharded ``TrainState`` (params, optimizer
+state, batch stats, step — ``ckpt/sharded.py``). Serving needs exactly
+one slice of it: the params. Two properties of the checkpoint layout
+make that slice cheap and world-independent:
+
+* ``TrainState.tree_flatten`` puts ``params`` FIRST, and replicated
+  leaves are round-robin-assigned by flat leaf index — so the params
+  occupy flat indices ``0..n_params-1`` regardless of what optimizer
+  trained them. The loader never has to reconstruct (or even know) the
+  optimizer's state tree; ZeRO bucket rows are simply never assembled.
+* shard assembly is already world-independent: an N-host training
+  checkpoint loads into an M-device inference mesh by reading the N
+  shards' round-robin homes — the PR 9 reshard-on-load story, params
+  edition.
+
+:class:`ReloadWatcher` is the rolling-reload half: it polls the
+checkpoint root with the stat-only ``manifest.latest_manifest`` probe
+(no shard is opened until a NEW complete manifest appears), loads the
+params, and stages them into the engine — which swaps between scheduler
+iterations, dropping no in-flight request (docs/SERVING.md).
+"""
+
+import logging
+import threading
+
+import jax
+import numpy as np
+
+from horovod_tpu.ckpt import manifest as manifest_lib
+from horovod_tpu.ckpt import sharded as sharded_lib
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def abstract_params(model, sample_tokens=None, seq_len=8):
+    """Shape-only params tree of ``model`` (flax) via ``jax.eval_shape``
+    — the restore target :func:`load_params` slices a checkpoint
+    against, built without materializing a single weight."""
+    import jax.numpy as jnp
+
+    if sample_tokens is None:
+        sample_tokens = jnp.zeros((1, int(seq_len)), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda rng: model.init(rng, sample_tokens),
+        jax.random.PRNGKey(0))
+    return shapes["params"]
+
+
+def _assemble(root, step, target_leaves, treedef):
+    man = manifest_lib.read_manifest(root, step)
+    src_world = int(man["world"])
+    shards = man.get("shards") or {}
+    n = len(target_leaves)
+    # params are the tree PREFIX: leaf i lives in shard i % src_world —
+    # only those shards are read (each CRC-checked against the manifest)
+    needed = sorted({i % src_world for i in range(n)})
+    payloads = {r: sharded_lib._read_shard(root, step, r, src_world,
+                                           shards.get(str(r)))
+                for r in needed}
+    out = []
+    for i, leaf in enumerate(target_leaves):
+        try:
+            saved = payloads[i % src_world]["repl"][str(i)]
+        except KeyError:
+            raise ValueError(
+                f"checkpoint step {step} has no replicated leaf {i} of "
+                f"{n} — the params-prefix contract expects a TrainState "
+                "checkpoint (ckpt/sharded.py) whose params tree matches "
+                "the serving model") from None
+        saved = np.asarray(saved)
+        if saved.shape != tuple(leaf.shape):
+            # msgpack round-trips 0-d arrays as shape (1,); any
+            # same-size difference is a benign layout artifact
+            if saved.size == int(np.prod(leaf.shape, dtype=np.int64)):
+                saved = saved.reshape(leaf.shape)
+            else:
+                raise ValueError(
+                    f"checkpoint params leaf {i} has shape "
+                    f"{saved.shape}, the serving model expects "
+                    f"{tuple(leaf.shape)} — wrong model config for this "
+                    "checkpoint")
+        if saved.dtype != leaf.dtype:
+            saved = saved.astype(leaf.dtype)
+        out.append(saved)
+    return jax.tree_util.tree_unflatten(treedef, out), \
+        man.get("meta") or {}
+
+
+def load_params(root, params_target, step=None):
+    """Load ONLY the parameter tree of a sharded checkpoint.
+
+    ``params_target`` is a shape/dtype tree (:func:`abstract_params`,
+    or a live tree). ``step=None`` picks the newest manifest-complete
+    step, falling back past steps whose shards fail validation —
+    restore-side torn-write philosophy, same as
+    ``ckpt.restore_sharded``; an explicit ``step`` fails loudly.
+    Returns ``(step, params, meta)`` with host-numpy leaves cast to the
+    target dtypes (a bf16 serving config loads an fp32 checkpoint
+    narrowed; same-dtype loads are bitwise)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params_target)
+    if step is not None:
+        if not manifest_lib.is_complete(root, step):
+            raise FileNotFoundError(
+                f"step {step} under {root} has no "
+                f"{manifest_lib.MANIFEST_NAME} (incomplete/torn "
+                "checkpoint)")
+        params, meta = _assemble(root, step, leaves, treedef)
+        return step, params, meta
+    steps = manifest_lib.list_complete_steps(root)
+    if not steps:
+        raise FileNotFoundError(
+            f"no manifest-complete checkpoint under {root}")
+    last_err = None
+    for s in reversed(steps):
+        try:
+            params, meta = _assemble(root, s, leaves, treedef)
+            return s, params, meta
+        except (OSError, sharded_lib.ShardValidationError) as e:
+            logger.warning(
+                "serve: ckpt step %d under %s is unloadable (%s) — "
+                "falling back to the previous complete step", s, root, e)
+            last_err = e
+    raise ValueError(
+        f"no loadable checkpoint under {root}: all {len(steps)} "
+        "manifest-complete step(s) failed validation") from last_err
+
+
+class ReloadWatcher:
+    """Rolling weight reload: poll ``root`` for a newer complete
+    manifest, load its params, stage them into the engine.
+
+    The poll is the stat-only :func:`ckpt.manifest.complete_manifests`
+    probe. Candidates are ranked by **manifest mtime**, not step
+    number: recency by commit time is what survives the documented
+    backwards-step-numbering case — a damaged highest-numbered step
+    forces training's fallback restore, after which fresh commits carry
+    LOWER step numbers (with newer mtimes). Ranking by step would pin
+    the watcher on the damaged step forever and blind it to every fresh
+    commit beneath it. The ``(step, mtime)`` key also catches a
+    re-commit of the same step number. A probe whose shards fail
+    validation is remembered (and dropped once its dir is GC'd) and not
+    retried; the engine keeps serving the weights it has. Swap
+    semantics live in ``ServeEngine.install_weights``: between
+    iterations, in-flight requests carried over."""
+
+    def __init__(self, root, engine, params_target, poll_s=2.0,
+                 on_reload=None):
+        self._root = root
+        self._engine = engine
+        self._target = params_target
+        self._poll_s = float(poll_s)
+        self._on_reload = on_reload
+        self._seen = None    # (step, mtime) last installed
+        self._bad = set()    # (step, mtime) probes that failed to load
+        self._stop = threading.Event()
+        self._thread = None
+
+    def poll_once(self):
+        """One probe+maybe-reload cycle; returns the newly installed
+        step or None. Synchronous — the deterministic test surface."""
+        probes = manifest_lib.complete_manifests(self._root)
+        self._bad &= set(probes)  # GC'd/re-committed dirs drop out
+        candidates = [p for p in probes if p not in self._bad]
+        if not candidates:
+            return None
+        probe = max(candidates, key=lambda p: (p[1], p[0]))
+        if probe == self._seen:
+            return None
+        step = probe[0]
+        try:
+            loaded_step, params, _ = load_params(self._root,
+                                                 self._target, step=step)
+        except Exception as e:
+            logger.warning(
+                "serve: reload of ckpt step %d failed (%s) — keeping "
+                "the current weights", step, e)
+            self._bad.add(probe)
+            return None
+        self._engine.install_weights(params, version=loaded_step)
+        self._seen = probe
+        logger.info("serve: staged reloaded weights from ckpt step %d",
+                    loaded_step)
+        if self._on_reload is not None:
+            self._on_reload(loaded_step)
+        return loaded_step
+
+    def mark_current(self, step):
+        """Record the step already installed at startup so the first
+        poll doesn't re-load it."""
+        mt = manifest_lib.manifest_mtime(self._root, step)
+        if mt is not None:
+            self._seen = (step, mt)
+
+    def _loop(self):
+        while not self._stop.wait(self._poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # keep watching; serving must not die
+                logger.warning("serve: reload poll failed",
+                               exc_info=True)
+
+    def start(self):
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="hvd_serve_reload",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

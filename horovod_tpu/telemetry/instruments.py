@@ -66,6 +66,13 @@ DATA_QUEUE_DEPTH = "hvd_data_queue_depth"
 DATA_BYTES_STAGED = "hvd_data_bytes_staged_total"
 DATA_BATCHES = "hvd_data_batches_total"
 DATA_LOAD_SECONDS = "hvd_data_load_seconds"
+# -- serving plane (horovod_tpu/serve, docs/SERVING.md) ---------------------
+SERVE_REQUESTS = "hvd_serve_requests_total"
+SERVE_TOKENS = "hvd_serve_tokens_total"
+SERVE_QUEUE_DEPTH = "hvd_serve_queue_depth"
+SERVE_KV_BLOCKS = "hvd_serve_kv_blocks_in_use"
+SERVE_TTFT_SECONDS = "hvd_serve_ttft_seconds"
+SERVE_INTER_TOKEN_SECONDS = "hvd_serve_inter_token_seconds"
 # -- goodput ledger (telemetry/ledger.py, docs/OBSERVABILITY.md) ------------
 TIME_SECONDS = "hvd_time_seconds_total"
 GOODPUT_RATIO = "hvd_goodput_ratio"
@@ -114,6 +121,8 @@ CATALOGUE = (
     CKPT_INFLIGHT,
     DATA_WAIT_SECONDS, DATA_LOAD_SECONDS, DATA_QUEUE_DEPTH,
     DATA_BYTES_STAGED, DATA_BATCHES,
+    SERVE_REQUESTS, SERVE_TOKENS, SERVE_QUEUE_DEPTH, SERVE_KV_BLOCKS,
+    SERVE_TTFT_SECONDS, SERVE_INTER_TOKEN_SECONDS,
     TIME_SECONDS, GOODPUT_RATIO, BUILD_INFO,
 )
 
@@ -441,6 +450,50 @@ class DataInstruments:
 
 def data_instruments(registry=None):
     return DataInstruments(registry)
+
+
+class ServeInstruments:
+    """The inference server's request-level instruments
+    (docs/SERVING.md, docs/OBSERVABILITY.md "Serving plane"): request
+    lifecycle counts by event, generated-token throughput, scheduler
+    queue depth, paged-KV pool occupancy, and the two latencies a
+    serving SLO is written against — time-to-first-token (arrival →
+    first streamed token: queueing + prefill) and inter-token latency
+    (the steady-state decode cadence)."""
+
+    def __init__(self, registry=None):
+        r = registry if registry is not None else get_registry()
+        self.registry = r
+        self._requests = r.counter(
+            SERVE_REQUESTS,
+            "Generate requests by lifecycle event (submitted / "
+            "completed / failed)", label_names=("event",))
+        self.submitted = self._requests.labels("submitted")
+        self.completed = self._requests.labels("completed")
+        self.failed = self._requests.labels("failed")
+        self.tokens = r.counter(
+            SERVE_TOKENS, "Tokens generated and streamed to clients")
+        self.queue_depth = r.gauge(
+            SERVE_QUEUE_DEPTH,
+            "Requests admitted-pending (queued behind KV blocks or "
+            "batch slots)")
+        self.kv_blocks = r.gauge(
+            SERVE_KV_BLOCKS, "Paged-KV pool blocks currently allocated "
+            "to live sequences")
+        self.ttft_seconds = r.histogram(
+            SERVE_TTFT_SECONDS,
+            "Time to first token: request arrival -> first streamed "
+            "token (queueing + prefill)")
+        self.inter_token_seconds = r.histogram(
+            SERVE_INTER_TOKEN_SECONDS,
+            "Gap between successive streamed tokens of one request "
+            "(steady-state decode cadence)",
+            buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                     1.0, 2.5))
+
+
+def serve_instruments(registry=None):
+    return ServeInstruments(registry)
 
 
 def build_info_labels(config=None):

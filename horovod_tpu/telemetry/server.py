@@ -29,34 +29,34 @@ import json
 import logging
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from horovod_tpu.telemetry.registry import get_registry
+from horovod_tpu.utils.httpd import HttpService, QuietHandler
 
 logger = logging.getLogger("horovod_tpu")
 
 DEFAULT_PROFILE_DIR = "/tmp/horovod_tpu_profile"
 
 
-class MetricsServer:
+class MetricsServer(HttpService):
     """One rank's scrape endpoint. ``port=0`` binds an ephemeral port
-    (the bound port is in ``.port`` after ``start()``)."""
+    (the bound port is in ``.port`` after ``start()``). Built on the
+    shared ``utils/httpd`` scaffolding (the serving frontend,
+    ``serve/server.py``, is the other tenant)."""
+
+    thread_name = "hvd_tpu_metrics"
 
     def __init__(self, addr="127.0.0.1", port=0, registry=None,
                  health_fn=None, profile_dir=None):
-        self._addr = addr
-        self._want_port = port
+        super().__init__(addr=addr, port=port)
         self.registry = registry if registry is not None else get_registry()
         self._health_fn = health_fn
         self.profile_dir = profile_dir or DEFAULT_PROFILE_DIR
-        self._httpd = None
-        self._thread = None
         self._profile_lock = threading.Lock()
         self._profile_active = False
         self._profile_thread = None
         self._profile_cancel = threading.Event()
-        self.port = None
 
     # -- profiling ----------------------------------------------------------
     def _start_profile(self, seconds):
@@ -94,17 +94,8 @@ class MetricsServer:
     def _handler_class(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):  # no stderr chatter
-                logger.debug("metrics server: " + fmt, *args)
-
-            def _respond(self, code, body, ctype):
-                data = body.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+        class Handler(QuietHandler):
+            log_name = "metrics"
 
             def do_GET(self):
                 url = urlparse(self.path)
@@ -171,26 +162,13 @@ class MetricsServer:
         return Handler
 
     def start(self):
-        self._httpd = ThreadingHTTPServer((self._addr, self._want_port),
-                                          self._handler_class())
-        self._httpd.daemon_threads = True
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="hvd_tpu_metrics",
-                                        daemon=True)
-        self._thread.start()
+        port = super().start()
         logger.info("metrics endpoint on http://%s:%d/metrics",
-                    self._addr, self.port)
-        return self.port
+                    self._addr, port)
+        return port
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        super().stop()
         if self._profile_thread is not None:
             # end any in-flight capture NOW and wait for the profiler's
             # native write to finish before the interpreter can exit

@@ -682,6 +682,8 @@ class _SpmdProgram:
 
     def __init__(self, plan, global_step, arg_specs, n_scalar_outs,
                  donate):
+        from horovod_tpu.parallel import gspmd as gspmd_lib
+
         self.plan = plan
         self._fn = global_step
         self._arg_specs = tuple(arg_specs)
@@ -689,7 +691,7 @@ class _SpmdProgram:
         self._donate = donate
         self.jitted = None
         self.state_shardings = None
-        self._programs = {}  # aval key -> (executable, collectives)
+        self._cache = gspmd_lib.CompiledProgramCache()
         self.compiled_collectives = None
 
     def jitted_for(self, placed_state):
@@ -708,42 +710,22 @@ class _SpmdProgram:
                 donate_argnums=(0,) if self._donate else ())
         return self.jitted
 
-    @staticmethod
-    def _aval_key(placed):
-        return tuple((tuple(jnp.shape(x)), str(jnp.result_type(x)))
-                     for x in jax.tree_util.tree_leaves(placed))
-
     def executable(self, placed):
         """ONE compile per argument-shape signature: AOT lower+compile
         on first sight of a shape set (a shorter final batch from a
         ``drop_last=False`` loader, an eval batch), then the cached
         executable — the jit wrapper would retrace those transparently,
         and this cache keeps that behavior instead of crashing on a
-        shape mismatch. The step wrappers CALL the executable (not the
-        jit wrapper): on this jax an AOT compile does not populate the
-        jit dispatch cache, so dispatching through the wrapper after
-        compiling for the byte accounting would compile the identical
-        module twice (minutes, on a real model). Each new program's
-        collectives are accounted as it is compiled — the same
+        shape mismatch. The cache/accounting machinery is the shared
+        ``gspmd.CompiledProgramCache`` (the serving engine wraps the
+        same one): executables are called directly, and each new
+        program's collectives are accounted as it compiles — the same
         once-per-compile semantics as the trace-time counters. Donation
         and in/out shardings were fixed at jit construction and carry
         into every executable."""
-        from horovod_tpu.parallel import gspmd as gspmd_lib
-
-        key = self._aval_key(placed)
-        entry = self._programs.get(key)
-        if entry is None:
-            compiled = self.jitted_for(placed[0]).lower(
-                *placed).compile()
-            try:
-                collectives = gspmd_lib.record_compiled_collectives(
-                    compiled)
-            except Exception:  # pragma: no cover - must not kill a step
-                collectives = {}
-            entry = (compiled, collectives)
-            self._programs[key] = entry
-        self.compiled_collectives = entry[1]
-        return entry[0]
+        ex = self._cache.executable(self.jitted_for(placed[0]), placed)
+        self.compiled_collectives = self._cache.last_collectives
+        return ex
 
     def lower(self, placed):
         """AOT lower with the executed path's placement — for

@@ -28,10 +28,13 @@ setup(
     description=("TPU-native distributed training framework with "
                  "Horovod's capabilities (XLA collectives data plane, "
                  "C++ host core, MPI-free launcher)"),
-    packages=["horovod_tpu", "horovod_tpu.jax", "horovod_tpu.models",
+    packages=["horovod_tpu", "horovod_tpu.ckpt", "horovod_tpu.data",
+              "horovod_tpu.diag", "horovod_tpu.elastic",
+              "horovod_tpu.jax", "horovod_tpu.models",
               "horovod_tpu.mxnet", "horovod_tpu.ops",
               "horovod_tpu.parallel", "horovod_tpu.run",
-              "horovod_tpu.runtime", "horovod_tpu.spark",
+              "horovod_tpu.runtime", "horovod_tpu.serve",
+              "horovod_tpu.spark", "horovod_tpu.telemetry",
               "horovod_tpu.tensorflow", "horovod_tpu.torch",
               "horovod_tpu.utils"],
     package_data={"horovod_tpu": ["lib/libhvdcore.so"]},
@@ -46,6 +49,7 @@ setup(
         "console_scripts": [
             "hvdrun = horovod_tpu.run.run:main",
             "hvd-doctor = horovod_tpu.diag.doctor:doctor_cli",
+            "hvd-serve = horovod_tpu.serve.cli:main",
         ],
     },
     cmdclass={"build_py": BuildWithNativeCore},

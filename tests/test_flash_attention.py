@@ -62,6 +62,30 @@ def test_mxu_block_floor_routes_degenerate_tilings_to_fallback():
                                np.asarray(_oracle(q, k, v)), atol=2e-5)
 
 
+def test_decode_shapes_route_to_dense_path():
+    """ISSUE 11 satellite: q_len == 1 (incremental decode — one new
+    token against a long cached K/V, the serve/engine.py hot loop) can
+    never tile onto an MXU-floor block; kernel_supported must route it
+    to the dense path EXPLICITLY — for every cache length, including
+    ones whose kv side alone would tile — and the `attention` dispatch
+    wrapper must produce oracle values there, not a Mosaic rejection."""
+    for skv in (1, 7, 96, 512, 2048, 4096):
+        assert not fa.kernel_supported(1, skv, 64), skv
+    assert not fa.kernel_supported(512, 1, 64)  # kv side gates too
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((2, 1, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 512, 4, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 512, 4, 64)), jnp.float32)
+    # the decoding query sits at the END of the cached context
+    out = fa.attention(q, k, v, causal=True, q_offset=511)
+    q_pos = jnp.full((2, 1), 511)
+    kv_pos = jnp.broadcast_to(jnp.arange(512), (2, 512))
+    oracle = dense_attention(q, k, v, causal=True, q_positions=q_pos,
+                             kv_positions=kv_pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               atol=2e-5)
+
+
 def test_bf16_forward_and_grads_match_f32_oracle():
     """bf16 inputs run the MXU-native path (matmul operands stay bf16,
     accumulation/softmax fp32) — values must track the f32 oracle within
