@@ -1,0 +1,28 @@
+"""hvd-lint: project-native static analysis (docs/ANALYSIS.md).
+
+The runtime diagnosis plane (flight recorder, desync doctor, goodput
+ledger) names a desync, a host-sync stall, or a deadlock *after* it has
+burned a cluster allocation. This package is the static twin: AST
+passes over the tree that reject the same bug classes at review time —
+collectives reachable under rank-dependent control flow (HVD-DESYNC ↔
+``diag/desync.py``), silent host syncs inside jitted step functions
+(HVD-HOSTSYNC ↔ the goodput ledger's ``data_wait``/``overhead`` bills),
+lock-order cycles and locks held across blocking calls (HVD-LOCKORDER ↔
+the PR 7 recorder-watcher SIGTERM deadlock), unsafe signal handlers
+(HVD-SIGSAFE), broad exception handlers on the collective plane
+(HVD-EXCEPT), off-mesh ``pmap``/``shard_map`` call sites (HVD-MESH, the
+former tests/test_gspmd.py regex ratchet) and metric-name drift
+(HVD-METRIC, the former OBSERVABILITY.md↔CATALOGUE pytest guard).
+
+Public surface::
+
+    from horovod_tpu.analysis import run_lint, default_targets
+    result = run_lint(paths, baseline_path=...)   # LintResult
+    result.clean                                   # tier-1 gate bit
+"""
+
+from horovod_tpu.analysis.engine import (  # noqa: F401
+    Finding, LintError, LintResult, all_rules, default_targets,
+    load_baseline, run_lint, write_baseline,
+)
+from horovod_tpu.analysis import rules  # noqa: F401  (registers passes)

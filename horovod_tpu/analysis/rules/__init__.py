@@ -1,0 +1,8 @@
+"""The project-native passes. Importing this package registers every
+rule with the engine registry (``engine.register``); the public
+catalogue with one true-positive and one justified-suppression example
+per rule is docs/ANALYSIS.md."""
+
+from horovod_tpu.analysis.rules import (  # noqa: F401
+    desync, excepts, hostsync, lockorder, mesh, metric, sigsafe,
+)

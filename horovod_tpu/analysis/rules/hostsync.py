@@ -1,0 +1,104 @@
+"""HVD-HOSTSYNC: host synchronization inside functions that flow into
+``jit``/``make_train_step`` — ``.item()``, ``float()``, ``np.asarray``,
+``jax.device_get``, blocking I/O on traced values. These either fail at
+trace time or (worse) silently force a device→host readback every step,
+the pipeline stall the goodput ledger (runtime twin) can only *bill*
+after the fact, never prevent."""
+
+import ast
+
+from horovod_tpu.analysis import engine
+from horovod_tpu.analysis.rules import common
+
+_JIT_NAMES = frozenset({"jit", "pjit"})
+_STEP_BUILDERS = frozenset({"make_train_step", "make_lm_train_step"})
+
+# attribute calls that force a transfer regardless of receiver
+_SYNC_ATTRS = frozenset({"item", "tolist", "block_until_ready"})
+# numpy-ish module receivers whose asarray/array pulls a traced value
+_NP_RECEIVERS = frozenset({"np", "numpy", "onp"})
+_BLOCKING_NAMES = frozenset({"print", "open", "input"})
+
+
+def _jit_entry_names(tree):
+    """Names of functions that flow into a jit boundary in this module:
+    decorated with ``@jit``/``@jax.jit``/``@partial(jax.jit, ...)``, or
+    passed by name to ``jit(...)`` / ``make_train_step(...)``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if isinstance(target, ast.Attribute):
+                    dn = target.attr
+                elif isinstance(target, ast.Name):
+                    dn = target.id
+                else:
+                    continue
+                if dn in _JIT_NAMES:
+                    names.add(node.name)
+                elif dn == "partial" and isinstance(dec, ast.Call):
+                    for a in dec.args:
+                        an = a.attr if isinstance(a, ast.Attribute) else \
+                            getattr(a, "id", None)
+                        if an in _JIT_NAMES:
+                            names.add(node.name)
+        elif isinstance(node, ast.Call):
+            cn = common.call_name(node)
+            if cn in _JIT_NAMES or cn in _STEP_BUILDERS:
+                for a in list(node.args) + [k.value for k in
+                                            node.keywords]:
+                    if isinstance(a, ast.Name):
+                        names.add(a.id)
+    return names
+
+
+@engine.register(
+    "HVD-HOSTSYNC",
+    doc="host sync / blocking I/O inside a jit-traced function")
+def check(pf):
+    entries = _jit_entry_names(pf.tree)
+    if not entries:
+        return []
+    findings = []
+
+    def flag(node, what):
+        findings.append(engine.Finding(
+            rule="HVD-HOSTSYNC", file=pf.rel, line=node.lineno,
+            col=node.col_offset + 1,
+            message=f"{what} inside a jit-traced function",
+            hint="this forces a device→host sync (or a trace-time "
+                 "error) on the hot path — return the value and read "
+                 "it outside the step, or use a deferred telemetry "
+                 "gauge (runtime twin: the goodput ledger can only "
+                 "bill this stall)",
+            fingerprint=common.fingerprint(pf, node.lineno)))
+
+    def scan(fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = common.call_name(node)
+            recv = common.receiver_ident(node)
+            if name in _SYNC_ATTRS and recv is not None:
+                flag(node, f"`.{name}()`")
+            elif name in ("asarray", "array") and recv in _NP_RECEIVERS:
+                flag(node, f"`{recv}.{name}()` on a traced value")
+            elif name == "device_get":
+                flag(node, "`jax.device_get()`")
+            elif name in ("float", "bool") and isinstance(
+                    node.func, ast.Name) and node.args and not isinstance(
+                    node.args[0], ast.Constant):
+                flag(node, f"`{name}()` scalar conversion")
+            elif name in _BLOCKING_NAMES and isinstance(node.func,
+                                                        ast.Name):
+                flag(node, f"blocking `{name}()`")
+            elif name == "sleep" and (recv == "time" or isinstance(
+                    node.func, ast.Name)):
+                flag(node, "`time.sleep()`")
+
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in entries:
+            scan(node)
+    return findings

@@ -1,0 +1,45 @@
+"""HVD-MESH: explicit ``pmap(``/``shard_map(`` call sites — the former
+tests/test_gspmd.py regex ratchet, now an engine pass whose baseline
+lives in the committed baseline file. A new explicit per-rank call
+site moves work OFF the one logical mesh and out of the partitioner's
+reach (docs/PERFORMANCE.md "The GSPMD path"); the pinned legacy sites
+ride in the baseline, and the engine's stale-entry ratchet enforces
+that a removed site cannot silently come back.
+
+``compat.py`` (the version shim) and ``parallel/gspmd.py`` (the
+NamedSharding plan layer) are excluded by design, same as the old
+guard."""
+
+import ast
+
+from horovod_tpu.analysis import engine
+from horovod_tpu.analysis.rules import common
+
+_EXCLUDED_SUFFIXES = ("horovod_tpu/compat.py",
+                      "horovod_tpu/parallel/gspmd.py")
+_MESH_CALLS = frozenset({"pmap", "shard_map"})
+
+
+@engine.register(
+    "HVD-MESH",
+    doc="explicit pmap/shard_map call site off the logical mesh")
+def check(pf):
+    rel = pf.rel.replace("\\", "/")
+    if rel.endswith(_EXCLUDED_SUFFIXES):
+        return []
+    findings = []
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Call):
+            name = common.call_name(node)
+            if name in _MESH_CALLS:
+                findings.append(engine.Finding(
+                    rule="HVD-MESH", file=pf.rel, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=f"explicit `{name}(` call site off the "
+                            "logical mesh",
+                    hint="express the sharding as NamedSharding / "
+                         "with_sharding_constraint on the one logical "
+                         "mesh (parallel/gspmd.py) — justify any new "
+                         "per-rank call site in the PR",
+                    fingerprint=common.fingerprint(pf, node.lineno)))
+    return findings

@@ -245,5 +245,6 @@ def _tpu_backend_already_live():
             return False
         import jax
         return any(d.platform == "tpu" for d in jax.devices())
+    # hvd-lint: disable=HVD-EXCEPT -- internal-API probe across jax versions; False is safe
     except Exception:  # pragma: no cover - internal API drift
         return False

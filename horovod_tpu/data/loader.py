@@ -143,6 +143,8 @@ class PrefetchLoader:
         self._offset = 0
         self._batch_index = 0
         self._lock = threading.Lock()
+        # serializes whole halts (detach + join): see _halt_producer
+        self._halt_lock = threading.Lock()
         self._queue = None
         self._thread = None
         self._stop = None
@@ -229,10 +231,28 @@ class PrefetchLoader:
                     return
                 start = after
             _put(q, (gen, "end", None, None), stop)
+        # hvd-lint: disable=HVD-EXCEPT -- producer thread: everything (incl. control flow) is re-raised on the consumer via the queue
         except BaseException as e:  # noqa: BLE001 — surfaced on the consumer
             _put(q, (gen, "error", e, None), stop)
 
     def _ensure_producer(self):
+        # steady path: a live producer needs no halt coordination —
+        # the consumer checks under self._lock alone and stays out of
+        # any in-flight halt's way
+        if self._closed:
+            raise RuntimeError("PrefetchLoader is closed")
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+        # (re)start path: serialize with halts — a consumer must not
+        # spawn a NEW producer while a halt is still joining the old
+        # one (two threads concurrently inside source.batch(), or a
+        # producer born after close() detached the stream). Same
+        # _halt_lock → _lock order as _halt_producer, so no cycle.
+        with self._halt_lock:
+            self._ensure_producer_locked()
+
+    def _ensure_producer_locked(self):
         if self._closed:
             raise RuntimeError("PrefetchLoader is closed")
         with self._lock:
@@ -260,22 +280,39 @@ class PrefetchLoader:
             self._thread.start()
 
     def _halt_producer(self):
-        with self._lock:
-            t, q, stop = self._thread, self._queue, self._stop
-            if t is None:
-                self._gen += 1
+        # detach under self._lock, JOIN OUTSIDE it (hvd-lint
+        # HVD-LOCKORDER): a producer parked in a slow storage read
+        # (FileSource delay_s simulates exactly this) used to hold
+        # every other loader entry point — including the elastic reset
+        # path, whose recovery time is otherwise carefully bounded —
+        # hostage for the whole read. The queue is generation-keyed, so
+        # __next__ ignores anything the detached producer still emits.
+        #
+        # _halt_lock serializes WHOLE halts (and producer (re)starts):
+        # every _halt_producer caller mutates cursor/source state right
+        # after it returns (set_cursor, on_reset, close), so a second
+        # halter must park here until the previous halt's producer has
+        # really died — not skip ahead on seeing _thread already None
+        # and call source.set_state() under a zombie's in-flight
+        # batch() read. Consumers on the steady path (live producer)
+        # only take self._lock and stay unblocked; a consumer that
+        # needs a (re)start parks behind the halt by design.
+        with self._halt_lock:
+            with self._lock:
+                t, q, stop = self._thread, self._queue, self._stop
+                self._thread = None
                 self._queue = None
-                return
-            stop.set()
+                self._gen += 1
+                if t is None:
+                    return
+                stop.set()
             while t.is_alive():
                 try:  # unblock a producer parked in q.put
                     q.get_nowait()
                 except queue.Empty:
                     pass
+                # hvd-lint: disable=HVD-LOCKORDER -- _halt_lock guards only halts (no other acquisition path) and the join MUST finish before the caller mutates source state
                 t.join(timeout=0.05)
-            self._thread = None
-            self._queue = None
-            self._gen += 1
 
     # -- the consumer -------------------------------------------------------
     def __iter__(self):
@@ -392,6 +429,7 @@ class PrefetchLoader:
         self._exhausted = False
         try:
             self._source.set_state(cur.get("source") or {})
+        # hvd-lint: disable=HVD-EXCEPT -- cursor still applies; source extras are best-effort
         except Exception:
             logger.warning("data: source rejected its cursor state",
                            exc_info=True)
@@ -437,8 +475,11 @@ class PrefetchLoader:
         self.placement_spec = spec
 
     def close(self):
-        self._halt_producer()
+        # closed BEFORE the halt: a consumer parked behind the halt in
+        # _ensure_producer must observe the close when it resumes, not
+        # spawn a post-close producer (leaked thread doing I/O)
         self._closed = True
+        self._halt_producer()
 
     def __enter__(self):
         return self

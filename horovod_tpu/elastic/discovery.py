@@ -174,6 +174,7 @@ class HostDiscoveryPoller:
                 if res != HostUpdateResult.NO_UPDATE and self._on_update:
                     try:
                         self._on_update(added, removed, dict(new), res)
+                    # hvd-lint: disable=HVD-EXCEPT -- a bad update listener must not kill host discovery
                     except Exception:
                         logger.exception("host-update callback failed")
             return dict(new)
@@ -192,6 +193,7 @@ class HostDiscoveryPoller:
         while not self._stop.wait(self._interval):
             try:
                 self.poll_once()
+            # hvd-lint: disable=HVD-EXCEPT -- poll loop: transient discovery failures retry next tick
             except Exception:
                 logger.exception("host discovery poll failed")
 

@@ -502,6 +502,7 @@ class ElasticDriver:
         while not stop_event.wait(interval):
             try:
                 self.cluster_view()
+            # hvd-lint: disable=HVD-EXCEPT -- monitor loop: the view refresh retries next tick
             except Exception:
                 logger.debug("cluster view refresh failed", exc_info=True)
 

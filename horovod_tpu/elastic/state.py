@@ -74,6 +74,7 @@ class State:
         try:
             self.flush(timeout=float(
                 os.environ.get("HOROVOD_CKPT_RESET_TIMEOUT", "10")))
+        # hvd-lint: disable=HVD-EXCEPT -- a failed flush must not block the recovery path
         except Exception as e:  # noqa: BLE001 — a failed flush must not
             logger.warning("elastic: checkpoint flush before reset "
                            "failed: %s — abandoning the in-flight save "
@@ -106,8 +107,8 @@ class State:
         try:
             from horovod_tpu import basics
             inspector = basics._state.stall_inspector
-        except Exception:
-            pass
+        except (ImportError, AttributeError):
+            pass  # services not installed yet; heartbeat goes direct
         if inspector is not None:
             inspector.record_progress(step)
         from horovod_tpu.elastic import worker
@@ -361,6 +362,7 @@ class JaxState(ObjectState):
                 # membership changed: re-shard the REMAINING sample
                 # space across the new world (docs/DATA.md)
                 self._loader.on_reset()
+            # hvd-lint: disable=HVD-EXCEPT -- never block recovery; the reshard failure is logged
             except Exception:  # noqa: BLE001 — never block recovery
                 logger.warning("elastic: loader reshard on reset failed",
                                exc_info=True)

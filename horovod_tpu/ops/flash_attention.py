@@ -37,6 +37,7 @@ from jax.experimental import pallas as pl
 
 try:  # Mosaic TPU backend; absent on some CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
+# hvd-lint: disable=HVD-EXCEPT -- import probe: Mosaic backend absent on CPU-only installs
 except Exception:  # pragma: no cover
     pltpu = None
 

@@ -333,6 +333,7 @@ class CompiledProgramCache:
             try:
                 collectives = record_compiled_collectives(
                     compiled, prefix=self.prefix)
+            # hvd-lint: disable=HVD-EXCEPT -- HLO accounting must not kill a step
             except Exception:  # pragma: no cover — must not kill a step
                 collectives = {}
             entry = (compiled, collectives)
@@ -353,6 +354,7 @@ def record_compiled_collectives(compiled, prefix="spmd"):
 
     try:
         text = compiled if isinstance(compiled, str) else compiled.as_text()
+    # hvd-lint: disable=HVD-EXCEPT -- HLO text unavailable on this jax; accounting skipped
     except Exception:
         return {}
     ops = collective_bytes_from_hlo(text)

@@ -33,6 +33,7 @@ import sys
 
 from horovod_tpu.run import allocation
 from horovod_tpu.run import secret as _secret
+from horovod_tpu.run import task_exec
 from horovod_tpu.run.discovery import DriverService, TaskAgent
 from horovod_tpu.run.rendezvous import (KVStoreServer, kv_get, kv_put,
                                         kv_wait)
@@ -132,12 +133,21 @@ class SparkBackend(ClusterBackend):
         import threading
 
         def _mapper(index, _it):
-            yield cluster_task(index, num_tasks, ctx)
+            # reraise_control_flow=False: under Spark a task EXCEPTION
+            # means automatic task RETRY — which would re-run the whole
+            # user fn against a completed rendezvous. cluster_task
+            # swallows ONLY the control flow exec_and_publish has
+            # already published (the launcher still raises on the
+            # payload); an interrupt during rendezvous setup — nothing
+            # published yet — still propagates and fails the job fast.
+            yield cluster_task(index, num_tasks, ctx,
+                               reraise_control_flow=False)
 
         def _run():
             try:
                 self._sc.range(0, num_tasks, numSlices=num_tasks) \
                     .mapPartitionsWithIndex(_mapper).collect()
+            # hvd-lint: disable=HVD-EXCEPT -- surfaces via alive()/wait(); the backend thread must not die
             except Exception as e:  # surfaces via alive()
                 self._error.append(e)
 
@@ -157,8 +167,15 @@ class SparkBackend(ClusterBackend):
         self._sc.cancelAllJobs()
 
 
-def cluster_task(index, num_tasks, ctx):
-    """Task-side protocol, runs inside a cluster executor."""
+def cluster_task(index, num_tasks, ctx, reraise_control_flow=True):
+    """Task-side protocol, runs inside a cluster executor.
+
+    ``reraise_control_flow``: whether a KeyboardInterrupt/SystemExit
+    escaping the user fn propagates after its failure payload is
+    published. True for subprocess backends (process death keeps the
+    signal's semantics); False for schedulers like Spark where a task
+    exception means automatic retry — the one case where "swallow
+    after publishing" is the correct plane semantic."""
     key = _secret.decode_key(ctx["key"])
     os.environ[_secret.SECRET_ENV] = ctx["key"]
     kv_addr, kv_port = ctx["kv_addr"], int(ctx["kv_port"])
@@ -179,13 +196,17 @@ def cluster_task(index, num_tasks, ctx):
     fn, args, kwargs = _pickler.loads(
         kv_wait(kv_addr, kv_port, "runfunc/func", auth_key=key))
     try:
-        result = fn(*args, **kwargs)
-        payload = pickle.dumps((True, result))
+        task_exec.exec_and_publish(
+            fn, args, kwargs,
+            lambda payload: kv_put(kv_addr, kv_port,
+                                   f"runfunc/result/{rank}", payload,
+                                   auth_key=key))
     except BaseException:
-        import traceback
-        payload = pickle.dumps((False, traceback.format_exc()))
-    kv_put(kv_addr, kv_port, f"runfunc/result/{rank}", payload,
-           auth_key=key)
+        # only exec_and_publish's re-raised CONTROL FLOW reaches here —
+        # its payload is already published, and plain Exceptions were
+        # packaged inside it (never re-raised)
+        if reraise_control_flow:
+            raise
     return rank
 
 

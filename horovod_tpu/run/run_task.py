@@ -6,9 +6,9 @@ result."""
 import os
 import pickle
 import sys
-import traceback
 
 from horovod_tpu.run.rendezvous import kv_put, kv_wait
+from horovod_tpu.run.task_exec import exec_and_publish
 
 try:
     import cloudpickle as _pickler  # noqa: F401
@@ -22,14 +22,12 @@ def main():
     rank = int(os.environ["HOROVOD_RANK"])
     fn, args, kwargs = pickle.loads(
         kv_wait(addr, port, "runfunc/func", timeout=60))
-    try:
-        value = fn(*args, **kwargs)
-        payload = pickle.dumps((True, value))
-    except BaseException:
-        payload = pickle.dumps((False, traceback.format_exc()))
-        kv_put(addr, port, f"runfunc/result/{rank}", payload)
+    ok = exec_and_publish(
+        fn, args, kwargs,
+        lambda payload: kv_put(addr, port, f"runfunc/result/{rank}",
+                               payload))
+    if not ok:
         sys.exit(1)
-    kv_put(addr, port, f"runfunc/result/{rank}", payload)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,43 @@
+"""Shared task-body execution for the shipped-function executors
+(``run_task.py`` — the programmatic ``run(fn)`` worker — and
+``cluster.py::cluster_task`` — the cluster-executor callback).
+
+Both must publish a ``(ok, value-or-traceback)`` payload to the
+launcher's KV store no matter how ``fn`` ends: a worker that dies
+silently parks the launcher on ``kv_wait`` until its timeout. But the
+two planes used to disagree on *control-flow* exceptions, and the
+cluster side swallowed them outright: ``except BaseException`` turned a
+KeyboardInterrupt / SystemExit inside ``fn`` into a published failure
+followed by a NORMAL task return — the executor reported a clean exit
+to its scheduler and kept running, exactly the "rank told to die keeps
+running" shape hvd-lint's HVD-EXCEPT pass exists to reject. The one
+policy now lives here: publish first (the launcher must learn the
+outcome either way), then re-raise anything that is not a plain
+``Exception`` so the signal keeps its meaning.
+"""
+
+import pickle
+import traceback
+
+
+def exec_and_publish(fn, args, kwargs, publish):
+    """Run ``fn(*args, **kwargs)`` and hand ``publish`` the pickled
+    ``(ok, value)`` payload. Returns True on success, False when ``fn``
+    raised an ordinary ``Exception`` (traceback published). Control
+    flow — ``KeyboardInterrupt``/``SystemExit``/any non-``Exception``
+    ``BaseException`` — is published as a failure and then RE-RAISED.
+    """
+    try:
+        payload = pickle.dumps((True, fn(*args, **kwargs)))
+    # hvd-lint: disable=HVD-EXCEPT -- failure IS the result: published to the launcher; control flow re-raises below
+    except Exception:
+        publish(pickle.dumps((False, traceback.format_exc())))
+        return False
+    except BaseException:
+        # publish-then-reraise: the launcher stops waiting on this
+        # rank, and the executor still dies with the interrupt's
+        # semantics instead of reporting a clean exit
+        publish(pickle.dumps((False, traceback.format_exc())))
+        raise
+    publish(payload)
+    return True

@@ -158,6 +158,7 @@ def start(state):
                 from horovod_tpu.elastic import worker as elastic_worker
                 elastic_worker.attach_progress_reporter(
                     state.stall_inspector)
+            # hvd-lint: disable=HVD-EXCEPT -- optional elastic wiring; the stall inspector works alone
             except Exception:
                 logger.warning("elastic worker context failed to attach",
                                exc_info=True)
@@ -179,6 +180,7 @@ def stop(state):
             if dump_dir:
                 led.write_dump(dump_dir, state.config.rank)
         state.ledger = None
+    # hvd-lint: disable=HVD-EXCEPT -- shutdown path: the ledger dump is best-effort
     except Exception:
         logger.warning("goodput ledger dump failed", exc_info=True)
     if state.metrics_server is not None:

@@ -65,6 +65,7 @@ class StallInspector:
         for fn in list(self._progress_listeners):
             try:
                 fn(step)
+            # hvd-lint: disable=HVD-EXCEPT -- a bad listener must not kill the progress watchdog
             except Exception:
                 logger.debug("progress listener failed", exc_info=True)
 
@@ -81,6 +82,7 @@ class StallInspector:
                 beats = self._heartbeat_fn() or {}
                 return [r for r, t in beats.items()
                         if now - t > self._warning_time]
+            # hvd-lint: disable=HVD-EXCEPT -- heartbeat view is advisory; falls back to own idleness
             except Exception:
                 logger.debug("heartbeat_fn failed", exc_info=True)
         idle = now - self._last_progress
@@ -112,6 +114,7 @@ class StallInspector:
                 _flightrec.record_event("stall", idle_s=round(idle, 3),
                                         stalled=sorted(stalled))
                 _flightrec.dump_now("stall")
+            # hvd-lint: disable=HVD-EXCEPT -- forensics dump is best-effort on the warning path
             except Exception:
                 logger.debug("stall flight-recorder dump failed",
                              exc_info=True)
@@ -124,6 +127,7 @@ class StallInspector:
             if self._on_shutdown is not None:
                 try:
                     self._on_shutdown()
+                # hvd-lint: disable=HVD-EXCEPT -- a shutdown-hook failure must not mask the stall itself
                 except Exception:
                     logger.warning("stall shutdown hook failed",
                                    exc_info=True)

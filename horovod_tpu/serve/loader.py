@@ -172,6 +172,7 @@ class ReloadWatcher:
         try:
             loaded_step, params, _ = load_params(self._root,
                                                  self._target, step=step)
+        # hvd-lint: disable=HVD-EXCEPT -- bad ckpt is remembered+skipped; current weights keep serving
         except Exception as e:
             logger.warning(
                 "serve: reload of ckpt step %d failed (%s) — keeping "
@@ -197,6 +198,7 @@ class ReloadWatcher:
         while not self._stop.wait(self._poll_s):
             try:
                 self.poll_once()
+            # hvd-lint: disable=HVD-EXCEPT -- keep watching; serving must not die
             except Exception:  # keep watching; serving must not die
                 logger.warning("serve: reload poll failed",
                                exc_info=True)

@@ -85,11 +85,13 @@ class ServeServer(HttpService):
                         self._respond(404, "not found\n", "text/plain")
                 except BrokenPipeError:
                     pass
+                # hvd-lint: disable=HVD-EXCEPT -- keep the plane up; the handler reports 500 below
                 except Exception as e:
                     logger.warning("serve endpoint %s failed: %s",
                                    self.path, e)
                     try:
                         self._respond(500, f"{e}\n", "text/plain")
+                    # hvd-lint: disable=HVD-EXCEPT -- the client is gone; nothing left to report to
                     except Exception:
                         pass
 
@@ -126,10 +128,12 @@ class ServeServer(HttpService):
                     self._stream(req)
                 except BrokenPipeError:
                     pass  # client went away mid-stream; engine finishes
+                # hvd-lint: disable=HVD-EXCEPT -- keep the plane up; the handler reports 500 below
                 except Exception as e:
                     logger.warning("serve /generate failed: %s", e)
                     try:
                         self._respond(500, f"{e}\n", "text/plain")
+                    # hvd-lint: disable=HVD-EXCEPT -- the client is gone; nothing left to report to
                     except Exception:
                         pass
 

@@ -169,6 +169,7 @@ class Gauge(_Metric):
                     return self._value
             try:
                 return float(fn())
+            # hvd-lint: disable=HVD-EXCEPT -- gauge callback: NaN marks a failed read
             except Exception:
                 return float("nan")
 

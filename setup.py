@@ -28,7 +28,9 @@ setup(
     description=("TPU-native distributed training framework with "
                  "Horovod's capabilities (XLA collectives data plane, "
                  "C++ host core, MPI-free launcher)"),
-    packages=["horovod_tpu", "horovod_tpu.ckpt", "horovod_tpu.data",
+    packages=["horovod_tpu", "horovod_tpu.analysis",
+              "horovod_tpu.analysis.rules",
+              "horovod_tpu.ckpt", "horovod_tpu.data",
               "horovod_tpu.diag", "horovod_tpu.elastic",
               "horovod_tpu.jax", "horovod_tpu.models",
               "horovod_tpu.mxnet", "horovod_tpu.ops",
@@ -49,6 +51,7 @@ setup(
         "console_scripts": [
             "hvdrun = horovod_tpu.run.run:main",
             "hvd-doctor = horovod_tpu.diag.doctor:doctor_cli",
+            "hvd-lint = horovod_tpu.analysis.cli:main",
             "hvd-serve = horovod_tpu.serve.cli:main",
         ],
     },

@@ -1,0 +1,868 @@
+"""hvd-lint (ISSUE 12): the static-analysis engine and its passes.
+
+Three layers, mirroring docs/ANALYSIS.md's contract:
+
+1. every rule is itself regression-tested against small positive AND
+   negative fixture snippets (a pass that silently stops firing is a
+   lint bug, not a clean tree);
+2. the engine mechanics — suppressions need justifications, the
+   baseline is a dated shrink-only ratchet, the CLI exit codes are
+   0 clean / 1 findings / 2 engine error;
+3. the tier-1 gate: the full engine over ``horovod_tpu/``,
+   ``examples/`` and ``bench*.py`` reports ZERO unbaselined findings
+   and zero stale baseline entries.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import (LintError, default_targets, engine,
+                                  run_lint)
+from horovod_tpu.analysis import cli as lint_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, ".hvd-lint-baseline.json")
+
+
+def lint_src(tmp_path, src, name="mod.py", rules=None, **kw):
+    """Lint one fixture snippet; returns the LintResult."""
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return run_lint([str(tmp_path)], root=str(tmp_path), rules=rules,
+                    **kw)
+
+
+def rules_of(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ---------------------------------------------------------------------------
+# HVD-DESYNC
+
+
+def test_desync_flags_collective_under_rank_branch(tmp_path):
+    r = lint_src(tmp_path, """
+        import horovod_tpu as hvd
+        def save(x):
+            if hvd.rank() == 0:
+                hvd.allreduce(x)
+    """)
+    assert rules_of(r) == ["HVD-DESYNC"]
+    assert r.findings[0].line == 5
+    assert "rank-dependent" in r.findings[0].message
+
+
+def test_desync_flags_rank_conditional_early_exit(tmp_path):
+    r = lint_src(tmp_path, """
+        import horovod_tpu as hvd
+        def save(x, local_rank):
+            if local_rank != 0:
+                return None
+            return hvd.broadcast(x, root_rank=0)
+    """)
+    assert rules_of(r) == ["HVD-DESYNC"]
+    assert "early exit" in r.findings[0].message
+
+
+def test_desync_flags_nested_early_exit(tmp_path):
+    """A rank-conditional return buried under a `with` (or any
+    non-def nesting) still exits the function for those ranks — the
+    collective after it must be flagged."""
+    r = lint_src(tmp_path, """
+        import contextlib
+        import horovod_tpu as hvd
+        def fn(x, rank):
+            if rank != 0:
+                with contextlib.nullcontext():
+                    return None
+            return hvd.allreduce(x)
+    """)
+    assert rules_of(r) == ["HVD-DESYNC"]
+    assert "early exit" in r.findings[0].message
+
+
+def test_desync_flags_boolop_short_circuit(tmp_path):
+    r = lint_src(tmp_path, """
+        import horovod_tpu as hvd
+        def maybe(x, rank):
+            return rank == 0 and hvd.allgather(x)
+    """)
+    assert rules_of(r) == ["HVD-DESYNC"]
+
+
+def test_desync_negative_world_common_and_target_rank(tmp_path):
+    """No finding for world-common conditions, target-rank parameters
+    (``root_rank`` names WHICH rank, every rank passes the same value),
+    plural rank collections, or rank use that never gates a
+    collective."""
+    r = lint_src(tmp_path, """
+        import horovod_tpu as hvd
+        def fine(x, size, root_rank, stalled_ranks):
+            if size > 1:
+                x = hvd.allreduce(x)
+            if root_rank is not None:
+                x = hvd.broadcast(x, root_rank=root_rank)
+            if stalled_ranks:
+                x = hvd.allreduce(x)
+            if hvd.rank() == 0:
+                print("only logging here")
+            return x
+    """)
+    assert r.findings == []
+
+
+def test_desync_break_continue_taint_only_their_loop(tmp_path):
+    """``continue``/``break`` end an iteration, not the function: a
+    collective AFTER the loop is reached by every rank (no finding),
+    while one later in the SAME loop body is skipped per-rank (finding).
+    A loop over a rank-dependent range is rank-conditional wholesale."""
+    clean = lint_src(tmp_path, """
+        import horovod_tpu as hvd
+        def fn(x, items):
+            for i in items:
+                if hvd.rank() == i:
+                    continue
+            return hvd.allreduce(x)
+    """)
+    assert clean.findings == []
+    dirty = lint_src(tmp_path, """
+        import horovod_tpu as hvd
+        def fn(x, items):
+            for i in items:
+                if hvd.rank() == i:
+                    continue
+                x = hvd.allreduce(x)
+            return x
+    """, name="dirty.py")
+    assert rules_of(dirty) == ["HVD-DESYNC"]
+    ranged = lint_src(tmp_path, """
+        import horovod_tpu as hvd
+        def fn(x):
+            for _ in range(hvd.rank()):
+                x = hvd.allreduce(x)
+            return x
+    """, name="ranged.py")
+    assert rules_of(ranged) == ["HVD-DESYNC"]
+
+
+def test_desync_scope_is_per_function(tmp_path):
+    """A rank-conditional early exit in one function does not taint a
+    collective in a nested (separately-called) function."""
+    r = lint_src(tmp_path, """
+        import horovod_tpu as hvd
+        def outer(x, rank):
+            if rank != 0:
+                return None
+            def inner(y):
+                return hvd.allreduce(y)
+            return inner
+    """)
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------------
+# HVD-HOSTSYNC
+
+
+def test_hostsync_flags_syncs_in_jitted_fn(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax, numpy as np
+        def loss(params, batch):
+            v = params.mean()
+            print("dbg", v)
+            host = np.asarray(v)
+            jax.device_get(v)
+            return float(v) + host.item()
+        step = jax.jit(loss)
+    """)
+    assert rules_of(r) == ["HVD-HOSTSYNC"]
+    kinds = " ".join(f.message for f in r.findings)
+    for marker in ("print", "np.asarray", "device_get", "float",
+                   ".item()"):
+        assert marker in kinds, marker
+
+
+def test_hostsync_decorator_and_step_builder_entries(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+        from functools import partial
+        from horovod_tpu import training
+
+        @jax.jit
+        def a(x):
+            return float(x)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def b(x):
+            return x.item()
+
+        def loss_fn(p, batch):
+            return p.tolist()
+        step = training.make_train_step(loss_fn, None)
+    """)
+    assert len(r.findings) == 3
+    assert rules_of(r) == ["HVD-HOSTSYNC"]
+
+
+def test_hostsync_negative_outside_jit(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax, numpy as np
+        def logger_hook(state):
+            return float(np.asarray(state.loss).item())
+        def traced(x):
+            return x * 2
+        step = jax.jit(traced)
+    """)
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------------
+# HVD-LOCKORDER
+
+
+def test_lockorder_flags_join_and_bounded_put_under_lock(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading, queue
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = queue.Queue(maxsize=2)
+                self._thread = threading.Thread(target=lambda: None)
+            def stop(self):
+                with self._lock:
+                    self._thread.join(timeout=1)
+            def emit(self, ev):
+                with self._lock:
+                    self._queue.put(ev)
+    """)
+    assert rules_of(r) == ["HVD-LOCKORDER"]
+    msgs = " ".join(f.message for f in r.findings)
+    assert ".join()" in msgs and ".put()" in msgs
+
+
+def test_lockorder_flags_collective_under_lock(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading
+        import horovod_tpu as hvd
+        _lock = threading.Lock()
+        def publish(x):
+            with _lock:
+                return hvd.allreduce(x)
+    """)
+    assert rules_of(r) == ["HVD-LOCKORDER"]
+    assert "collective dispatch" in r.findings[0].message
+
+
+def test_lockorder_detects_cross_file_cycle(tmp_path):
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        import threading
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        def one():
+            with lock_a:
+                with lock_b:
+                    pass
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        from a import lock_a, lock_b
+        def two():
+            with lock_b:
+                with lock_a:
+                    pass
+    """))
+    r = run_lint([str(tmp_path)], root=str(tmp_path))
+    cyc = [f for f in r.findings if "cycle" in f.message]
+    assert cyc, [f.message for f in r.findings]
+    assert "lock_a" in cyc[0].message and "lock_b" in cyc[0].message
+
+
+def test_lockorder_negatives(tmp_path):
+    """str.join, dict.get, Condition-style self-wait (releases while
+    parked), and closures defined (not run) under the lock are all
+    clean."""
+    r = lint_src(tmp_path, """
+        import threading
+        class W:
+            def __init__(self):
+                self._lock = threading.Condition()
+                self._mu = threading.Lock()
+                self._cfg = {}
+            def fmt(self, parts):
+                with self._mu:
+                    return ", ".join(parts) + str(self._cfg.get("k"))
+            def park(self):
+                with self._lock:
+                    self._lock.wait()
+            def deferred(self):
+                with self._mu:
+                    def later():
+                        import time
+                        time.sleep(1)
+                    return later
+    """)
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------------
+# HVD-SIGSAFE
+
+
+def test_sigsafe_flags_blocking_handler(tmp_path):
+    r = lint_src(tmp_path, """
+        import signal, threading, logging
+        logger = logging.getLogger(__name__)
+        _dump_lock = threading.Lock()
+        def _handler(signum, frame):
+            with _dump_lock:
+                open("/tmp/dump", "w").write("x")
+            logger.warning("dying")
+        signal.signal(signal.SIGTERM, _handler)
+    """)
+    assert rules_of(r) == ["HVD-SIGSAFE"]
+    msgs = " ".join(f.message for f in r.findings)
+    assert "with _dump_lock" in msgs and "open()" in msgs \
+        and "logging" in msgs
+
+
+def test_sigsafe_negative_nested_def_in_handler(tmp_path):
+    """The rule's own recommended fix — define the work in a nested
+    function and run it on a watcher thread — must not be flagged: a
+    def inside the handler does not execute in the handler."""
+    r = lint_src(tmp_path, """
+        import signal, threading, time
+        def _handler(signum, frame):
+            def _later():
+                time.sleep(1)
+                open("/tmp/dump", "w").write("x")
+            threading.Thread(target=_later, daemon=True).start()
+        signal.signal(signal.SIGTERM, _handler)
+    """)
+    assert r.findings == []
+
+
+def test_sigsafe_negative_flag_style_handler(tmp_path):
+    """Set-a-flag / non-blocking-acquire handlers (the recorder's
+    compliant pattern) are clean; so are modules with no handlers."""
+    r = lint_src(tmp_path, """
+        import signal, threading
+        done = threading.Event()
+        _dump_lock = threading.Lock()
+        def _handler(signum, frame):
+            if _dump_lock.acquire(blocking=False):
+                _dump_lock.release()
+            done.set()
+        signal.signal(signal.SIGTERM, _handler)
+        def not_a_handler():
+            open("/tmp/x", "w")
+    """)
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------------
+# HVD-EXCEPT
+
+
+def test_except_flags_broad_and_bare(tmp_path):
+    r = lint_src(tmp_path, """
+        def a():
+            try:
+                return 1
+            except Exception:
+                return 0
+        def b():
+            try:
+                return 1
+            except:
+                return 0
+        def c():
+            try:
+                return 1
+            except BaseException:
+                return 0
+    """)
+    assert len(r.findings) == 3
+    assert rules_of(r) == ["HVD-EXCEPT"]
+    bare = [f for f in r.findings if "bare" in f.message]
+    assert bare and "KeyboardInterrupt" in bare[0].message
+
+
+def test_except_negative_reraise_and_narrow(tmp_path):
+    r = lint_src(tmp_path, """
+        def a():
+            try:
+                return 1
+            except Exception as e:
+                raise RuntimeError("wrapped") from e
+        def b():
+            try:
+                return 1
+            except (ValueError, OSError):
+                return 0
+    """)
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------------
+# HVD-MESH
+
+
+def test_mesh_flags_pmap_but_not_shim_layers(tmp_path):
+    pkg = tmp_path / "horovod_tpu"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text("import jax\nf = jax.pmap(lambda x: x)\n")
+    (pkg / "compat.py").write_text(
+        "import jax\ng = jax.shard_map(lambda x: x)\n")
+    r = run_lint([str(pkg)], root=str(tmp_path))
+    assert [f.file for f in r.findings if f.rule == "HVD-MESH"] == \
+        [os.path.join("horovod_tpu", "hot.py")]
+
+
+# ---------------------------------------------------------------------------
+# HVD-METRIC (fixture project tree)
+
+
+def _metric_tree(tmp_path, doc_rows, register_name):
+    pkg = tmp_path / "horovod_tpu" / "telemetry"
+    pkg.mkdir(parents=True)
+    (pkg / "instruments.py").write_text(textwrap.dedent("""
+        STEP_TOTAL = "hvd_step_total"
+        LOSS = "hvd_loss"
+        CATALOGUE = (STEP_TOTAL, LOSS)
+        LEGACY_ALIASES = {STEP_TOTAL: "horovod_step_total"}
+    """))
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "| metric | type |\n|---|---|\n" +
+        "".join(f"| `{n}` | counter |\n" for n in doc_rows))
+    (tmp_path / "horovod_tpu" / "user.py").write_text(textwrap.dedent(f"""
+        def install(registry):
+            return registry.counter({register_name!r}, "help")
+    """))
+    return run_lint([str(tmp_path / "horovod_tpu")],
+                    root=str(tmp_path))
+
+
+def test_metric_clean_tree(tmp_path):
+    r = _metric_tree(tmp_path, ["hvd_step_total", "hvd_loss"],
+                     "hvd_step_total")
+    assert r.findings == []
+
+
+def test_metric_catalogue_accepts_string_literal_elements(tmp_path):
+    """A direct string element in CATALOGUE is as catalogued as a
+    named constant — it must not surface as a documented ghost."""
+    pkg = tmp_path / "horovod_tpu" / "telemetry"
+    pkg.mkdir(parents=True)
+    (pkg / "instruments.py").write_text(textwrap.dedent("""
+        STEP_TOTAL = "hvd_step_total"
+        CATALOGUE = (STEP_TOTAL, "hvd_literal_total")
+        LEGACY_ALIASES = {}
+    """))
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "| metric | type |\n|---|---|\n"
+        "| `hvd_step_total` | counter |\n"
+        "| `hvd_literal_total` | counter |\n")
+    r = run_lint([str(tmp_path / "horovod_tpu")], root=str(tmp_path))
+    assert r.findings == []
+
+
+def test_metric_flags_ghost_missing_and_uncatalogued_use(tmp_path):
+    r = _metric_tree(tmp_path, ["hvd_step_total", "hvd_ghost_total"],
+                     "hvd_rogue_total")
+    msgs = {f.message.split("`")[1]: f for f in r.findings}
+    assert set(msgs) == {"hvd_ghost_total", "hvd_loss",
+                         "hvd_rogue_total"}
+    # the ghost anchors at its table row, the use-site at its call
+    assert msgs["hvd_ghost_total"].file == "docs/OBSERVABILITY.md"
+    assert msgs["hvd_ghost_total"].line == 4
+    assert msgs["hvd_rogue_total"].file.endswith("user.py")
+
+
+def test_metric_doc_findings_are_baselinable(tmp_path):
+    """Findings anchored in the (never-walked) docs file must spend
+    baseline budget like any other — and repeated ``--baseline write``
+    must not duplicate their entries (the doc is in the pass's
+    scope_files, so the entry is in scope on both the read and the
+    write path)."""
+    r = _metric_tree(tmp_path, ["hvd_step_total", "hvd_loss",
+                                "hvd_ghost_total"], "hvd_step_total")
+    assert len(r.findings) == 1  # the documented ghost
+    base = tmp_path / "base.json"
+    engine.write_baseline(str(base), r.all_findings)
+
+    def rerun():
+        return run_lint([str(tmp_path / "horovod_tpu")],
+                        root=str(tmp_path), baseline_path=str(base))
+
+    r2 = rerun()
+    assert r2.clean and len(r2.baselined) == 1
+    # a second write-from-current-state keeps exactly one entry
+    previous = engine.load_baseline(str(base))
+    engine.write_baseline(
+        str(base), r2.all_findings, previous=previous,
+        keep=[e for e in previous
+              if not engine.entry_in_scope(e, r2, str(tmp_path))])
+    assert len(engine.load_baseline(str(base))) == 1
+    assert rerun().clean
+
+
+def test_overlapping_targets_parse_each_file_once(tmp_path):
+    (tmp_path / "m.py").write_text(textwrap.dedent(_EXCEPT_SRC))
+    r = run_lint([str(tmp_path), str(tmp_path / "m.py")],
+                 root=str(tmp_path))
+    assert r.files == 1 and len(r.findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: suppressions
+
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    r = lint_src(tmp_path, """
+        def a():
+            try:
+                return 1
+            except Exception:  # hvd-lint: disable=HVD-EXCEPT -- probe, absence is the answer
+                return 0
+        def b():
+            try:
+                return 1
+            # hvd-lint: disable=HVD-EXCEPT -- forensics must never throw
+            except Exception:
+                return 0
+    """)
+    assert r.findings == []
+    assert len(r.suppressed) == 2
+
+
+def test_suppression_requires_justification(tmp_path):
+    r = lint_src(tmp_path, """
+        def a():
+            try:
+                return 1
+            except Exception:  # hvd-lint: disable=HVD-EXCEPT
+                return 0
+    """)
+    rules = rules_of(r)
+    assert "HVD-SUPPRESS" in rules  # the bare disable is itself flagged
+    assert "HVD-EXCEPT" in rules    # and does NOT suppress
+
+
+def test_suppression_text_inside_strings_is_inert(tmp_path):
+    """Suppression-shaped text inside docstrings/string literals (e.g.
+    documentation of the syntax) must neither suppress nor be flagged
+    as malformed — only real comment tokens count."""
+    r = lint_src(tmp_path, '''
+        DOC = """write `# hvd-lint: disable=HVD-EXCEPT` to suppress"""
+        def a():
+            try:
+                return 1
+            except Exception:
+                return 0
+    ''')
+    assert rules_of(r) == ["HVD-EXCEPT"]  # no HVD-SUPPRESS phantom
+    r2 = lint_src(tmp_path, '''
+        def a():
+            try:
+                return 1
+            except Exception: s = "# hvd-lint: disable=HVD-EXCEPT -- justified?"
+    ''', name="strsup.py")
+    # the string ON the finding line must NOT have suppressed it
+    assert any(f.rule == "HVD-EXCEPT" and f.file.endswith("strsup.py")
+               for f in r2.findings)
+
+
+def test_suppression_is_rule_scoped(tmp_path):
+    r = lint_src(tmp_path, """
+        def a():
+            try:
+                return 1
+            except Exception:  # hvd-lint: disable=HVD-DESYNC -- wrong rule
+                return 0
+    """)
+    assert rules_of(r) == ["HVD-EXCEPT"]
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: baseline ratchet
+
+
+_EXCEPT_SRC = """
+    def a():
+        try:
+            return 1
+        except Exception:
+            return 0
+"""
+
+_CLEAN_SRC = """
+    def a():
+        try:
+            return 1
+        except ValueError:
+            return 0
+"""
+
+
+def test_baseline_absorbs_then_ratchets(tmp_path):
+    base = tmp_path / "base.json"
+    r = lint_src(tmp_path, _EXCEPT_SRC)
+    assert len(r.all_findings) == 1
+    engine.write_baseline(str(base), r.all_findings)
+    entries = engine.load_baseline(str(base))
+    assert all(e["date"] for e in entries)  # every entry is dated
+
+    # baselined: clean run, finding accounted
+    r2 = lint_src(tmp_path, _EXCEPT_SRC, baseline_path=str(base))
+    assert r2.clean and len(r2.baselined) == 1
+
+    # a NEW identical finding in another file is NOT covered
+    (tmp_path / "other.py").write_text(textwrap.dedent(_EXCEPT_SRC))
+    r3 = run_lint([str(tmp_path)], root=str(tmp_path),
+                  baseline_path=str(base))
+    assert not r3.clean and len(r3.findings) == 1
+
+    # fixing the baselined finding makes the entry STALE: the ratchet
+    # fails the run until the baseline is re-written
+    os.remove(tmp_path / "other.py")
+    r4 = lint_src(tmp_path, _CLEAN_SRC, baseline_path=str(base))
+    assert not r4.clean and r4.stale_baseline \
+        and r4.stale_baseline[0]["rule"] == "HVD-EXCEPT"
+    engine.write_baseline(str(base), r4.all_findings,
+                          previous=engine.load_baseline(str(base)))
+    r5 = lint_src(tmp_path, _CLEAN_SRC, baseline_path=str(base))
+    assert r5.clean
+
+
+def test_baseline_keeps_original_dates(tmp_path):
+    base = tmp_path / "base.json"
+    r = lint_src(tmp_path, _EXCEPT_SRC)
+    engine.write_baseline(str(base), r.all_findings, date="2020-01-01")
+    engine.write_baseline(str(base), r.all_findings,
+                          previous=engine.load_baseline(str(base)))
+    assert engine.load_baseline(str(base))[0]["date"] == "2020-01-01"
+
+
+def test_baseline_ignores_unwalked_files(tmp_path):
+    """A partial-target run must not trip the ratchet on entries for
+    files that exist under the root but were not linted."""
+    base = tmp_path / "base.json"
+    (tmp_path / "a.py").write_text(textwrap.dedent(_EXCEPT_SRC))
+    (tmp_path / "b.py").write_text(textwrap.dedent(_EXCEPT_SRC))
+    r = run_lint([str(tmp_path)], root=str(tmp_path))
+    engine.write_baseline(str(base), r.all_findings)
+    r2 = run_lint([str(tmp_path / "a.py")], root=str(tmp_path),
+                  baseline_path=str(base))
+    assert r2.clean, (r2.findings, r2.stale_baseline)
+
+
+def test_baseline_write_preserves_out_of_scope_entries(tmp_path):
+    """A partial-target (or --rules-restricted) ``--baseline write``
+    must not delete another subtree's debt: out-of-scope entries are
+    written back verbatim, dates intact."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "m.py").write_text(textwrap.dedent(_EXCEPT_SRC))
+    (tmp_path / "b" / "m.py").write_text(textwrap.dedent(_EXCEPT_SRC))
+    base = tmp_path / ".hvd-lint-baseline.json"
+    full = run_lint([str(tmp_path / "a"), str(tmp_path / "b")],
+                    root=str(tmp_path))
+    engine.write_baseline(str(base), full.all_findings,
+                          date="2020-01-01")
+    # re-write from a run that only walked a/ — b/'s entry must survive
+    part = run_lint([str(tmp_path / "a")], root=str(tmp_path),
+                    baseline_path=str(base))
+    assert part.clean
+    previous = engine.load_baseline(str(base))
+    engine.write_baseline(
+        str(base), part.all_findings, previous=previous,
+        keep=[e for e in previous
+              if not engine.entry_in_scope(e, part, str(tmp_path))])
+    entries = engine.load_baseline(str(base))
+    assert {e["file"] for e in entries} == \
+        {os.path.join("a", "m.py"), os.path.join("b", "m.py")}
+    assert all(e["date"] == "2020-01-01" for e in entries)
+    # and the full run is still clean under the merged ledger
+    assert run_lint([str(tmp_path / "a"), str(tmp_path / "b")],
+                    root=str(tmp_path), baseline_path=str(base)).clean
+
+
+def test_lockorder_multi_item_with_orders_left_to_right(tmp_path):
+    """``with a, b:`` acquires a then b — the a→b edge must register,
+    so the opposite nesting elsewhere closes a detectable cycle."""
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        import threading
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        def one():
+            with lock_a, lock_b:
+                pass
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        from a import lock_a, lock_b
+        def two():
+            with lock_b:
+                with lock_a:
+                    pass
+    """))
+    r = run_lint([str(tmp_path)], root=str(tmp_path))
+    assert any("cycle" in f.message for f in r.findings), \
+        [f.message for f in r.findings]
+
+
+def test_positional_nonblocking_forms_are_clean(tmp_path):
+    """``lock.acquire(False)`` / ``q.put(ev, False)`` are the same
+    non-blocking request as their keyword spellings — neither
+    HVD-SIGSAFE nor HVD-LOCKORDER may flag them."""
+    r = lint_src(tmp_path, """
+        import signal, threading, queue
+        _dump_lock = threading.Lock()
+        _mu = threading.Lock()
+        _queue = queue.Queue(maxsize=2)
+        def _handler(signum, frame):
+            if _dump_lock.acquire(False):
+                _dump_lock.release()
+        signal.signal(signal.SIGTERM, _handler)
+        def emit(ev):
+            with _mu:
+                _queue.put(ev, False)
+    """)
+    assert r.findings == []
+
+
+def test_parallel_walk_matches_sequential(tmp_path):
+    for i in range(6):
+        (tmp_path / f"m{i}.py").write_text(textwrap.dedent(_EXCEPT_SRC))
+    seq = run_lint([str(tmp_path)], root=str(tmp_path), jobs=1)
+    par = run_lint([str(tmp_path)], root=str(tmp_path), jobs=4)
+    assert [f.as_json() for f in seq.findings] == \
+        [f.as_json() for f in par.findings]
+
+
+def test_unparseable_file_is_engine_error(tmp_path):
+    (tmp_path / "bad.py").write_text("def broken(:\n")
+    with pytest.raises(LintError, match="cannot parse"):
+        run_lint([str(tmp_path)], root=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# the CLI: exit codes and formats
+
+
+def _cli(tmp_path, *argv):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hvd-lint"),
+         "--root", str(tmp_path)] + list(argv),
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=REPO))
+    return out
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    (tmp_path / "horovod_tpu").mkdir()
+    mod = tmp_path / "horovod_tpu" / "m.py"
+    mod.write_text(textwrap.dedent(_EXCEPT_SRC))
+
+    out = _cli(tmp_path)
+    assert out.returncode == 1  # findings
+    assert "HVD-EXCEPT" in out.stdout and "m.py:5" in out.stdout
+
+    out = _cli(tmp_path, "--format", "json")
+    data = json.loads(out.stdout)
+    assert data["clean"] is False
+    assert data["findings"][0]["rule"] == "HVD-EXCEPT"
+
+    out = _cli(tmp_path, "--baseline", "write")
+    assert out.returncode == 0
+    assert os.path.exists(tmp_path / ".hvd-lint-baseline.json")
+    out = _cli(tmp_path)
+    assert out.returncode == 0  # baselined -> clean
+
+    # the ratchet through the CLI: fix the finding, stale entry -> 1
+    mod.write_text(textwrap.dedent(_CLEAN_SRC))
+    out = _cli(tmp_path)
+    assert out.returncode == 1 and "STALE-BASELINE" in out.stdout
+
+    mod.write_text("def broken(:\n")
+    out = _cli(tmp_path)
+    assert out.returncode == 2  # engine error
+    assert "cannot parse" in out.stderr
+
+    out = _cli(tmp_path, "--rules", "NOT-A-RULE")
+    assert out.returncode == 2
+
+
+def test_cli_environment_failures_are_exit_2(tmp_path):
+    """An unwritable baseline or a missing root is an ENGINE error
+    (exit 2 + message), never a traceback masquerading as exit 1."""
+    (tmp_path / "horovod_tpu").mkdir()
+    (tmp_path / "horovod_tpu" / "m.py").write_text(
+        textwrap.dedent(_EXCEPT_SRC))
+    out = _cli(tmp_path, "--baseline", "write",
+               "--baseline-file", str(tmp_path / "nodir" / "base.json"))
+    assert out.returncode == 2, (out.stdout, out.stderr)
+    assert "hvd-lint: error:" in out.stderr
+    assert "Traceback" not in out.stderr
+
+    out = _cli(tmp_path / "missing-root")
+    assert out.returncode == 2, (out.stdout, out.stderr)
+    assert "Traceback" not in out.stderr
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the real tree is clean under the committed baseline
+
+
+def test_tree_is_clean_under_committed_baseline():
+    """ZERO unbaselined findings and zero stale entries over
+    horovod_tpu/, examples/ and bench*.py — the ISSUE 12 acceptance
+    gate. Every suppression in the tree carries a justification (a bare
+    disable surfaces as HVD-SUPPRESS right here) and every baseline
+    entry is dated."""
+    result = run_lint(default_targets(REPO), root=REPO,
+                      baseline_path=BASELINE)
+    assert result.clean, (
+        "hvd-lint found unbaselined findings (fix, suppress with a "
+        "justification, or — for pre-existing debt only — re-ratchet "
+        "with `hvd-lint --baseline write`):\n"
+        + "\n".join(f.format() for f in result.findings)
+        + "".join(f"\nstale baseline: {e}"
+                  for e in result.stale_baseline))
+    for e in engine.load_baseline(BASELINE):
+        assert len(e["date"]) == 10 and e["date"].count("-") == 2, \
+            f"undated baseline entry: {e}"
+
+
+def test_bin_hvd_lint_runs_without_jax(tmp_path):
+    """The analysis package is pure stdlib and bin/hvd-lint pre-seeds a
+    stub parent package, so a lint-only CI job on a machine WITHOUT
+    jax still lints (the metric pass AST-parses instruments.py, no
+    imports)."""
+    shadow = tmp_path / "shadow"
+    shadow.mkdir()
+    (shadow / "jax.py").write_text(
+        "raise ImportError('no jax on this machine')\n")
+    (tmp_path / "horovod_tpu").mkdir()
+    (tmp_path / "horovod_tpu" / "m.py").write_text(
+        textwrap.dedent(_EXCEPT_SRC))
+    env = dict(os.environ, PYTHONPATH=str(shadow))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hvd-lint"),
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, env=env)
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "HVD-EXCEPT" in out.stdout
+    assert "no jax" not in out.stderr
+
+
+def test_tree_default_targets_cover_the_acceptance_surface():
+    targets = {os.path.relpath(t, REPO) for t in default_targets(REPO)}
+    assert "horovod_tpu" in targets and "examples" in targets
+    assert any(t.startswith("bench") for t in targets)

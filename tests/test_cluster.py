@@ -3,6 +3,7 @@ run a real allreduce job through the cluster callback protocol with a
 fake (local-subprocess) cluster, and unit-check the rank grouping."""
 
 import os
+import sys
 
 import numpy as np
 import pytest
@@ -65,6 +66,46 @@ def test_cluster_rank_grouping_by_host_hash():
     assert by_rank[2] == (0, 1)   # other host → cross_rank 1
     vals = [v for v, *_ in results]
     np.testing.assert_allclose(vals, [2.0] * 3)  # mean of 1,2,3
+
+
+def test_exec_and_publish_publishes_and_reraises_control_flow():
+    """hvd-lint HVD-EXCEPT regression: ``cluster_task`` used to catch
+    ``BaseException``, publish the traceback, and RETURN NORMALLY — a
+    KeyboardInterrupt / SystemExit inside ``fn`` became a clean task
+    exit, the 'rank told to die keeps running' shape. The shared policy
+    (run/task_exec.py) must publish the failure (the launcher stops
+    waiting) and then re-raise control flow."""
+    import pickle
+
+    from horovod_tpu.run.task_exec import exec_and_publish
+
+    published = []
+
+    # ordinary success: payload published, True returned
+    assert exec_and_publish(lambda: 41 + 1, (), {}, published.append)
+    assert pickle.loads(published[-1]) == (True, 42)
+
+    # ordinary failure: traceback published, False returned, no raise
+    def boom():
+        raise ValueError("executor boom")
+
+    assert not exec_and_publish(boom, (), {}, published.append)
+    ok, tb = pickle.loads(published[-1])
+    assert not ok and "executor boom" in tb
+
+    # control flow: STILL published, then re-raised
+    def interrupted():
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        exec_and_publish(interrupted, (), {}, published.append)
+    ok, tb = pickle.loads(published[-1])
+    assert not ok and "KeyboardInterrupt" in tb
+
+    with pytest.raises(SystemExit):
+        exec_and_publish(lambda: sys.exit(3), (), {}, published.append)
+    ok, tb = pickle.loads(published[-1])
+    assert not ok and "SystemExit" in tb
 
 
 def test_cluster_failure_propagates():
@@ -147,6 +188,98 @@ def test_spark_backend_end_to_end_with_stub_context():
                              kv_host="127.0.0.1", kv_addr="127.0.0.1",
                              start_timeout=120)
     assert results == ["partition-ok", "partition-ok"]
+
+
+def test_spark_backend_control_flow_publishes_without_task_retry():
+    """hvd-lint HVD-EXCEPT follow-up: a SystemExit inside the user fn
+    under the Spark backend must surface to the LAUNCHER as the
+    published failure payload — but must NOT escape the mapper as an
+    exception, because a failed Spark task is automatically RETRIED
+    (re-running the whole user fn against a completed rendezvous).
+    Process death is the subprocess backends' semantic, not Spark's."""
+    import sys as _sys
+
+    from horovod_tpu.run.cluster import SparkBackend
+
+    def fn():
+        _sys.exit(3)
+
+    sc = _FakeSparkContext()
+    backend = SparkBackend(sc)
+    with pytest.raises(RuntimeError, match="SystemExit"):
+        run_on_cluster(fn, num_proc=2, backend=backend,
+                       kv_host="127.0.0.1", kv_addr="127.0.0.1",
+                       start_timeout=120)
+    backend.wait()  # no exception escaped the mapper into the backend
+    assert backend.alive()
+
+
+def test_cluster_task_control_flow_scoping(monkeypatch):
+    """The no-retry swallow applies ONLY to control flow that
+    exec_and_publish has already published: pre-publish interrupts
+    (during rendezvous setup — nothing on the KV yet) must propagate
+    even with reraise_control_flow=False, or the launcher spins on a
+    result key that will never appear."""
+    import pickle
+
+    from horovod_tpu.run import cluster
+
+    puts = {}
+
+    class _StubAgent:
+        def __init__(self, *a, **k):
+            pass
+
+        def register(self):
+            pass
+
+        def run_ring_probe(self, timeout=None):
+            pass
+
+        def common_interfaces(self, timeout=None):
+            pass
+
+        def shutdown(self):
+            pass
+
+    def fake_kv_wait(addr, port, key, timeout=None, auth_key=None):
+        if key.startswith("cluster/assign/"):
+            return b'{"HOROVOD_RANK": "0"}'
+        if key == "runfunc/func":
+            return pickle.dumps((_boom, (), {}))
+        raise AssertionError(key)
+
+    monkeypatch.setattr(cluster, "TaskAgent", _StubAgent)
+    monkeypatch.setattr(cluster, "kv_wait", fake_kv_wait)
+    monkeypatch.setattr(
+        cluster, "kv_put",
+        lambda addr, port, key, payload, auth_key=None:
+        puts.__setitem__(key, payload))
+    monkeypatch.setattr(cluster._secret, "decode_key", lambda k: b"k")
+    ctx = {"key": "00", "kv_addr": "127.0.0.1", "kv_port": 1}
+
+    # post-publish control flow: swallowed only with the Spark policy
+    with pytest.raises(SystemExit):
+        cluster.cluster_task(0, 1, ctx)  # subprocess default: re-raise
+    ok, tb = pickle.loads(puts.pop("runfunc/result/0"))
+    assert not ok and "SystemExit" in tb
+
+    assert cluster.cluster_task(0, 1, ctx,
+                                reraise_control_flow=False) == 0
+    ok, _ = pickle.loads(puts.pop("runfunc/result/0"))
+    assert not ok  # payload published even though nothing raised
+
+    # PRE-publish control flow: propagates regardless of the policy
+    monkeypatch.setattr(
+        _StubAgent, "register",
+        lambda self: (_ for _ in ()).throw(KeyboardInterrupt()))
+    with pytest.raises(KeyboardInterrupt):
+        cluster.cluster_task(0, 1, ctx, reraise_control_flow=False)
+    assert "runfunc/result/0" not in puts
+
+
+def _boom():
+    raise SystemExit(3)
 
 
 def test_spark_backend_propagates_job_failure():

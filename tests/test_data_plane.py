@@ -325,6 +325,181 @@ def test_source_error_surfaces_on_training_thread():
     ld.close()
 
 
+def test_halt_does_not_hold_lock_across_producer_join():
+    """hvd-lint HVD-LOCKORDER regression: ``_halt_producer`` used to
+    hold ``self._lock`` while join-looping on the producer thread, so a
+    producer parked in a slow storage read held every other loader
+    entry point (including the elastic reset path, whose recovery time
+    is otherwise bounded) hostage for the whole read. The halt must
+    only take the lock to detach the stream."""
+    import threading
+
+    release = threading.Event()
+    in_read = threading.Event()
+
+    class Slow(ArraySource):
+        def batch(self, indices):
+            in_read.set()
+            assert release.wait(10), "test stalled"
+            return super().batch(indices)
+
+    ld = PrefetchLoader(Slow([np.arange(32, dtype=np.float32)]), 4,
+                        rank=0, world=1, seed=3)
+    ld._ensure_producer()
+    assert in_read.wait(10)  # producer is parked in the storage read
+
+    halt_done = threading.Event()
+    halter = threading.Thread(
+        target=lambda: (ld._halt_producer(), halt_done.set()),
+        daemon=True)
+    halter.start()
+    try:
+        # while the halt is join-looping on the parked producer, the
+        # loader's lock must be free for other threads
+        deadline = time.time() + 5
+        acquired = False
+        while time.time() < deadline and not acquired:
+            acquired = ld._lock.acquire(timeout=0.1)
+            if acquired:
+                ld._lock.release()
+                break
+        assert acquired, ("loader lock held across the producer join — "
+                          "the HVD-LOCKORDER deadlock shape is back")
+        assert not halt_done.is_set()  # the join really was in flight
+    finally:
+        release.set()
+        halter.join(timeout=10)
+    assert halt_done.is_set()
+    # and the stream restarts correctly on the next generation
+    first = np.asarray(next(ld)[0])
+    assert first.shape == (4,)
+    ld.close()
+
+
+def test_concurrent_halts_serialize_until_the_producer_dies():
+    """The other half of the _halt_producer contract: every halt caller
+    mutates cursor/source state right after it returns (set_cursor /
+    on_reset / close), so a SECOND halter must park until the previous
+    halt's producer has really died — it must not skip ahead on seeing
+    the stream already detached and mutate the source under a zombie's
+    in-flight batch() read."""
+    import threading
+
+    release = threading.Event()
+    in_read = threading.Event()
+
+    class Slow(ArraySource):
+        def batch(self, indices):
+            in_read.set()
+            assert release.wait(10), "test stalled"
+            return super().batch(indices)
+
+    ld = PrefetchLoader(Slow([np.arange(32, dtype=np.float32)]), 4,
+                        rank=0, world=1, seed=3)
+    cur = ld.cursor()
+    ld._ensure_producer()
+    assert in_read.wait(10)  # producer parked in the storage read
+
+    halt_a_done = threading.Event()
+    halter_a = threading.Thread(
+        target=lambda: (ld._halt_producer(), halt_a_done.set()),
+        daemon=True)
+    halter_a.start()
+    # give A time to detach and enter its join loop
+    deadline = time.time() + 5
+    while ld._thread is not None and time.time() < deadline:
+        time.sleep(0.01)
+    assert ld._thread is None
+
+    set_cursor_done = threading.Event()
+    halter_b = threading.Thread(
+        target=lambda: (ld.set_cursor(cur), set_cursor_done.set()),
+        daemon=True)
+    halter_b.start()
+    try:
+        # B must be parked behind A's in-flight join, not mutating
+        # stream state while the producer is still inside batch()
+        assert not set_cursor_done.wait(0.5)
+    finally:
+        release.set()
+        halter_a.join(timeout=10)
+        halter_b.join(timeout=10)
+    assert halt_a_done.is_set() and set_cursor_done.is_set()
+    # and the repositioned stream is intact
+    np.testing.assert_array_equal(
+        np.asarray(next(ld)[0]),
+        np.asarray(next(PrefetchLoader(
+            ArraySource([np.arange(32, dtype=np.float32)]), 4, rank=0,
+            world=1, seed=3))[0]))
+    ld.close()
+
+
+def test_consumer_steady_path_skips_halt_coordination():
+    """With a LIVE producer, the consumer's _ensure_producer must not
+    touch _halt_lock — the hot path stays unblocked even while some
+    other loader operation holds the halt serialization."""
+    ld = PrefetchLoader(make_xy(), 4, rank=0, world=1, seed=7)
+    ld._ensure_producer()          # producer up and producing
+    time.sleep(0.05)
+    assert ld._halt_lock.acquire(timeout=1)
+    try:
+        # a batch pull with a live producer completes while the halt
+        # lock is held elsewhere
+        batch = np.asarray(next(ld)[0])
+        assert batch.shape == (4,)
+    finally:
+        ld._halt_lock.release()
+    ld.close()
+
+
+def test_no_producer_survives_close_racing_a_consumer():
+    """A consumer racing close() must not spawn a post-close producer:
+    _ensure_producer parks behind the in-flight halt and then observes
+    the close (closed is set BEFORE the halt), so after close() no
+    prefetch thread may be left doing I/O on the source."""
+    import threading
+
+    release = threading.Event()
+    in_read = threading.Event()
+    preexisting = set(threading.enumerate())
+
+    class Slow(ArraySource):
+        def batch(self, indices):
+            in_read.set()
+            assert release.wait(10), "test stalled"
+            return super().batch(indices)
+
+    ld = PrefetchLoader(Slow([np.arange(32, dtype=np.float32)]), 4,
+                        rank=0, world=1, seed=3)
+    ld._ensure_producer()
+    assert in_read.wait(10)
+
+    closer = threading.Thread(target=ld.close, daemon=True)
+    closer.start()
+    time.sleep(0.1)  # closer is inside the halt join
+
+    consumer_err = []
+
+    def consume():
+        try:
+            next(ld)
+        except Exception as e:
+            consumer_err.append(e)
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    consumer.start()
+    time.sleep(0.1)
+    release.set()
+    closer.join(timeout=10)
+    consumer.join(timeout=10)
+    assert consumer_err and "closed" in str(consumer_err[0])
+    # the evidence the review probe demanded: no prefetch thread
+    # STARTED DURING THIS TEST is left alive after close()
+    for t in set(threading.enumerate()) - preexisting:
+        assert not (t.name.startswith("hvd_data_prefetch")
+                    and t.is_alive()), t.name
+
+
 # ---- JaxState integration: cursor rides commit/restore/manifest ----------
 
 def _jax_state(ckpt_dir, loader, **kw):

@@ -8,7 +8,6 @@ sites may appear in ``horovod_tpu/`` outside the pinned baseline
 (``compat.py`` and ``parallel/gspmd.py`` excluded as the shim layers)."""
 
 import os
-import re
 import warnings
 
 import jax
@@ -29,55 +28,33 @@ _PKG = os.path.join(os.path.dirname(__file__), os.pardir, "horovod_tpu")
 
 # ---- tier-1 guard: the hot path stays on the mesh ---------------------
 
-# Pinned per-file pmap(/shard_map( call-site baseline. compat.py (the
-# version shim) and parallel/gspmd.py (the NamedSharding plan layer)
-# are excluded by design. If you are editing this dict: a NEW explicit
-# per-rank call site moves work OFF the one logical mesh and out of the
-# partitioner's reach — justify it in the PR, or express the sharding
-# as a NamedSharding/with_sharding_constraint instead.
-_SHARD_MAP_BASELINE = {
-    "training.py": 2,             # explicit classification + LM steps
-    "ops/collective.py": 1,       # eager Adasum staged tree
-    "ops/fusion.py": 1,           # autotune trial harness
-    "parallel/pipeline.py": 2,    # GPipe + 1F1B schedules
-}
-_EXCLUDED = {"compat.py", os.path.join("parallel", "gspmd.py")}
+# Thin wrapper over the hvd-lint engine's HVD-MESH pass (ISSUE 12): the
+# pinned call-site baseline now lives in the committed
+# .hvd-lint-baseline.json (dated entries; compat.py and
+# parallel/gspmd.py excluded inside the rule) and the engine's
+# stale-entry ratchet replaces the hand-rolled shrink check — a removed
+# pmap(/shard_map( site fails the run until the baseline is re-written
+# (`hvd-lint --baseline write`), so old slack cannot quietly readmit a
+# new explicit per-rank call site. Failure messages carry file:line.
 
 
 def test_guard_no_new_pmap_or_shard_map_call_sites():
-    pat = re.compile(r"\b(?:pmap|shard_map)\(")
-    found = {}
-    for dirpath, _, files in os.walk(_PKG):
-        if "__pycache__" in dirpath:
-            continue
-        for f in sorted(files):
-            if not f.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, f)
-            rel = os.path.relpath(path, _PKG)
-            if rel in _EXCLUDED:
-                continue
-            with open(path) as fh:
-                n = len(pat.findall(fh.read()))
-            if n:
-                found[rel] = n
-    for rel, n in sorted(found.items()):
-        allowed = _SHARD_MAP_BASELINE.get(rel, 0)
-        assert n <= allowed, (
-            f"{rel} has {n} pmap(/shard_map( call site(s), baseline "
-            f"allows {allowed}: the hot path must stay on the logical "
-            "mesh (NamedSharding + with_sharding_constraint, "
-            "parallel/gspmd.py) — see this test's header before "
-            "raising the baseline")
-    # the guard is a RATCHET: when call sites are removed, the baseline
-    # must shrink with them, or the slack quietly readmits a new one
-    stale = {rel: allowed for rel, allowed in _SHARD_MAP_BASELINE.items()
-             if found.get(rel, 0) < allowed}
-    assert not stale, (
-        f"baseline overstates call sites ({stale} vs found "
-        f"{ {r: found.get(r, 0) for r in stale} }): shrink "
-        "_SHARD_MAP_BASELINE so the removed sites cannot silently "
-        "come back")
+    from horovod_tpu.analysis import run_lint
+
+    repo = os.path.abspath(os.path.join(_PKG, os.pardir))
+    result = run_lint([_PKG], root=repo, rules={"HVD-MESH"},
+                      baseline_path=os.path.join(
+                          repo, ".hvd-lint-baseline.json"))
+    assert not result.findings, (
+        "new explicit pmap(/shard_map( call site(s) off the logical "
+        "mesh — express the sharding as NamedSharding / "
+        "with_sharding_constraint (parallel/gspmd.py) or justify the "
+        "baseline addition in the PR (docs/ANALYSIS.md):\n"
+        + "\n".join(f.format() for f in result.findings))
+    assert not result.stale_baseline, (
+        "HVD-MESH baseline overstates call sites — shrink it "
+        "(`hvd-lint --baseline write`) so removed sites cannot "
+        f"silently come back: {result.stale_baseline}")
 
 
 # ---- plan derivation --------------------------------------------------

@@ -695,26 +695,31 @@ def test_catalogue_is_canonical_hvd_prefixed():
 
 
 def test_docs_metric_table_matches_catalogue():
-    """The tier-1 drift contract: the metric tables in
-    docs/OBSERVABILITY.md list EXACTLY the names in
+    """The tier-1 drift contract, now a thin wrapper over the hvd-lint
+    HVD-METRIC pass (ISSUE 12): the metric tables in
+    docs/OBSERVABILITY.md must list EXACTLY the names in
     instruments.CATALOGUE — a metric added (or renamed) in code without
-    a catalogue row fails here, and so does a documented ghost."""
+    a catalogue row fails here with its file:line, so does a documented
+    ghost (at its table row), and so does a string-literal registration
+    of an uncatalogued hvd_* name anywhere in the package (the drift
+    the pytest-only version could not see)."""
     import os
-    import re
 
-    doc = os.path.join(os.path.dirname(__file__), os.pardir, "docs",
-                       "OBSERVABILITY.md")
-    with open(doc) as f:
-        text = f.read()
-    documented = set(re.findall(r"^\|\s*`(hvd_[a-z0-9_]+)`\s*\|", text,
-                                flags=re.MULTILINE))
-    catalogued = set(instruments.CATALOGUE)
-    assert documented - catalogued == set(), \
-        f"documented but not registered in instruments.py: " \
-        f"{sorted(documented - catalogued)}"
-    assert catalogued - documented == set(), \
-        f"registered in instruments.py but missing from the " \
-        f"docs/OBSERVABILITY.md catalogue: {sorted(catalogued - documented)}"
+    from horovod_tpu.analysis import run_lint
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    result = run_lint([os.path.join(repo, "horovod_tpu")], root=repo,
+                      rules={"HVD-METRIC"},
+                      baseline_path=os.path.join(
+                          repo, ".hvd-lint-baseline.json"))
+    assert result.clean, (
+        "metric-name drift (instruments.CATALOGUE is the one "
+        "authority — docs/OBSERVABILITY.md and every registration "
+        "site must agree):\n"
+        + "\n".join(f.format() for f in result.findings)
+        + "".join(f"\nstale baseline: {e}"
+                  for e in result.stale_baseline))
 
 
 def test_legacy_aliases_render_on_scrape():
