@@ -608,6 +608,153 @@ def data_plane_comparison(args):
     print(json.dumps(result))
 
 
+def _churn_schedule(steps, preemptions, seed):
+    """Map a seeded ChaosPlan's injection times onto step indices (same
+    seed -> same schedule), so the churn bench is reproducible and
+    comparable across runs the way the hvdrun chaos soak is."""
+    from horovod_tpu.chaos import ChaosPlan
+    plan = ChaosPlan.generate(seed=seed, interval=1.0, jitter=0.5,
+                              kinds=("sigterm",), count=preemptions)
+    if not plan.injections:
+        return []
+    t_max = plan.injections[-1].at or 1.0
+    # never step 0 (nothing committed yet) and strictly increasing
+    idxs, prev = [], 0
+    for inj in plan.injections:
+        idx = max(prev + 1, min(steps - 1,
+                                int(inj.at / t_max * (steps - 1))))
+        if idx >= steps:
+            break
+        idxs.append(idx)
+        prev = idx
+    return idxs
+
+
+def churn_comparison(args):
+    """``--churn``: goodput under a scripted preemption schedule — the
+    SLO gate of the preemption-native story (docs/ELASTIC.md, "Running
+    on spot capacity"). A small compiled train loop runs ``--churn-steps``
+    steps; at seeded schedule points the loop simulates a graceful
+    eviction exactly the way ``elastic/preempt.py`` spends it — a real
+    ``AsyncCheckpointer`` force-commit plus the drain window — inside
+    the ledger's ``preemption`` phase. The emitted ``goodput`` block
+    must then (a) hold the sum≈wall invariant (every lost second
+    attributed), (b) show a NON-ZERO ``preemption`` lane, and (c) keep
+    ``goodput_ratio`` at or above ``--churn-budget``. Any violation is
+    a loud nonzero exit — the gate, not a report. One JSON line, same
+    contract as the headline bench."""
+    import shutil
+    import sys
+    import tempfile
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ckpt import AsyncCheckpointer
+    from horovod_tpu.telemetry import ledger as ledger_lib
+    from horovod_tpu.telemetry import report as report_mod
+    from horovod_tpu.telemetry.registry import MetricsRegistry
+    from horovod_tpu.utils.benchmarks import sync
+
+    hvd.init()
+    steps = args.churn_steps
+    schedule = _churn_schedule(steps, args.churn_preemptions,
+                               args.churn_seed)
+
+    # enough matmul per step that compute dominates the loop on a CPU
+    # smoke run; the ratio gate is about attribution, not silicon speed
+    n = 192
+    rng = np.random.default_rng(args.churn_seed)
+    b = jnp.asarray(rng.standard_normal((n, n)) / (n ** 0.5))
+
+    @jax.jit
+    def train_step(x):
+        for _ in range(8):
+            x = x @ b
+        return x
+
+    x = jnp.ones((n, n))
+    tree = {"w": rng.standard_normal(1 << 16).astype(np.float32)}
+    root = tempfile.mkdtemp(prefix="hvd_bench_churn_")
+    ck = AsyncCheckpointer(root, keep=2, rank=0, world=1,
+                           registry=MetricsRegistry())
+    preempted_at = []
+    try:
+        sched = set(schedule)
+        sync(train_step(x))  # compile outside the measured window
+        # fresh attribution window: the SLO is about steady-state churn
+        # cost, not one-time compilation (which has its own lane in the
+        # headline modes)
+        led = ledger_lib.reset_run()
+        led.start()
+        for i in range(steps):
+            x = train_step(x)
+            sync(x)
+            led.settle_step()
+            if i in sched:
+                # one simulated graceful eviction: the grace commit (a
+                # REAL async-checkpointer flush — its blocked time lands
+                # in ckpt_stall, keeping phases exclusive) plus the
+                # drain window (announce + exit + relaunch stand-in),
+                # all inside the preemption lane like preempt.py spends
+                # the real thing
+                with led.phase("preemption"):
+                    ck.save(i, tree)
+                    ck.flush()
+                    _time.sleep(args.churn_drain_ms / 1e3)
+                preempted_at.append(i)
+                _count_simulated_preemption()
+        ck.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    result = {"metric": "goodput_under_churn", "unit": "ratio",
+              "steps": steps, "churn_seed": args.churn_seed,
+              "preemptions": len(preempted_at),
+              "preempted_at_steps": preempted_at,
+              "drain_ms": args.churn_drain_ms,
+              "budget": args.churn_budget}
+    failures = []
+    try:
+        block = report_mod.goodput_block()
+        result["goodput"] = block
+        preempt_s = float(block["phases"].get("preemption", 0.0))
+        result["preemption_seconds"] = round(preempt_s, 4)
+        result["value"] = block["goodput_ratio"]
+        if preempted_at and preempt_s <= 0.0:
+            failures.append(
+                "preemption lane is EMPTY despite "
+                f"{len(preempted_at)} scripted preemption(s) — the "
+                "eviction window is not being attributed")
+        if block["goodput_ratio"] < args.churn_budget:
+            failures.append(
+                f"goodput ratio {block['goodput_ratio']:.4f} under churn "
+                f"fell below the {args.churn_budget:.4f} budget")
+    except report_mod.GoodputInvariantError as e:
+        result["goodput_error"] = str(e)
+        failures.append(f"unattributed time under churn: {e}")
+    if failures:
+        result["slo"] = "FAIL"
+        print(json.dumps(result))
+        for f in failures:
+            print(f"bench --churn: SLO GATE FAILED: {f}", file=sys.stderr)
+        sys.exit(2)
+    result["slo"] = "PASS"
+    print(json.dumps(result))
+
+
+def _count_simulated_preemption():
+    from horovod_tpu.telemetry import instruments as _tele
+    from horovod_tpu.telemetry.registry import get_registry
+    get_registry().counter(
+        _tele.PREEMPTIONS_TOTAL,
+        "Preemption notices acted on, by source kind "
+        "(docs/OBSERVABILITY.md)",
+        label_names=("kind",)).labels("simulated").inc()
+
+
 def jnp_first(images_np):
     """First example as the model-init sample input."""
     import jax.numpy as jnp
@@ -786,6 +933,29 @@ def main():
                              "input-bound)")
     parser.add_argument("--prefetch-depth", type=int, default=3,
                         help="PrefetchLoader queue depth for --data-plane")
+    parser.add_argument("--churn", action="store_true",
+                        help="run ONLY the goodput-under-churn SLO gate: "
+                             "a compiled loop with seeded simulated "
+                             "graceful evictions (real checkpointer "
+                             "force-commit + drain window in the "
+                             "ledger's preemption lane); exits nonzero "
+                             "when the goodput ratio falls below "
+                             "--churn-budget, the preemption lane is "
+                             "empty, or any lost second is unattributed "
+                             "(docs/ELASTIC.md)")
+    parser.add_argument("--churn-steps", type=int, default=80,
+                        help="train steps for --churn")
+    parser.add_argument("--churn-preemptions", type=int, default=3,
+                        help="scripted preemptions for --churn")
+    parser.add_argument("--churn-seed", type=int, default=0,
+                        help="seed of the --churn preemption schedule")
+    parser.add_argument("--churn-budget", type=float, default=0.25,
+                        help="minimum acceptable goodput ratio under "
+                             "churn (CPU-smoke-tuned default; raise on "
+                             "real chips where compute dominates)")
+    parser.add_argument("--churn-drain-ms", type=float, default=40.0,
+                        help="simulated drain window per preemption "
+                             "(announce + exit + relaunch stand-in)")
     args = parser.parse_args()
     if args.repeats < 1:
         parser.error("--repeats must be >= 1")
@@ -799,6 +969,18 @@ def main():
         parser.error("--spmd is its own comparison mode; run it "
                      "separately from --overlap/--compression/"
                      "--data-plane")
+    if args.churn and (args.overlap or args.compression is not None
+                       or args.data_plane or args.spmd):
+        parser.error("--churn is its own comparison mode; run it "
+                     "separately from --overlap/--compression/"
+                     "--data-plane/--spmd")
+    if args.churn:
+        if args.churn_steps < 2:
+            parser.error("--churn-steps must be >= 2")
+        if args.churn_preemptions < 1:
+            parser.error("--churn-preemptions must be >= 1")
+        churn_comparison(args)
+        return
 
     if args.spmd:
         spmd_comparison(args)

@@ -49,6 +49,7 @@ class _State:
         self.metrics_server = None
         self.flight_recorder = None
         self.ledger = None  # goodput time ledger (telemetry/ledger.py)
+        self.preempt_handler = None  # graceful eviction (elastic/preempt.py)
         self.joined = False
 
 

@@ -21,6 +21,10 @@ diverged (desync), and a probable-cause classification:
   one (input pipeline starved) while peers wait in a collective.
 * ``compile stall``— a rank entered a step and emitted no collective
   since (stuck in compilation / first dispatch) while peers progressed.
+* ``graceful eviction`` — rank(s) ran the preemption drain path
+  (``elastic/preempt.py``): a spot notice / SIGTERM triggered a bounded
+  grace commit and a clean exit. NOT a failure — the verdict exists so a
+  drained host is never misreported as a dead rank.
 * ``healthy``      — every rank dumped via clean exit paths with nothing
   left open.
 
@@ -145,6 +149,9 @@ def diagnose(dumps, expected_size=None):
                 and last.get("ok") is False):
             failed = (last.get("seq"), last.get("op"))
         open_batch, open_wait = _data_state(d)
+        preempt_ev = _last_event(d, kinds=("preempt",))
+        evicted_rank = (preempt_ev is not None
+                        or "preempt" in (d.get("dump_reasons") or []))
         per_rank[r] = {
             "seq": d.get("collective_seq", 0),
             "completed": d.get("last_completed_seq", 0),
@@ -153,6 +160,8 @@ def diagnose(dumps, expected_size=None):
             "last_event": last,
             "data_open": open_batch,
             "data_wait_open": open_wait,
+            "preempt": preempt_ev,
+            "evicted": evicted_rank,
             "dump_reasons": d.get("dump_reasons") or [],
             "config_crc": d.get("config_crc"),
             "host": d.get("host"),
@@ -176,8 +185,9 @@ def diagnose(dumps, expected_size=None):
              if not i["parked"]
              and any(x in CLEAN_REASONS for x in i["dump_reasons"])]
 
+    evicted = sorted(r for r, i in per_rank.items() if i["evicted"])
     cause, why = _classify(expected, dead, digest_view, per_rank, parked,
-                           clean)
+                           clean, evicted)
 
     interrupted_saves = {}
     for r in ranks:
@@ -201,12 +211,14 @@ def diagnose(dumps, expected_size=None):
         "config_mismatch": config_mismatch,
         "classification": cause,
         "explanation": why,
+        "evicted_ranks": evicted,
         "interrupted_saves": interrupted_saves,
         "timeline": timeline,
     }
 
 
-def _classify(expected, dead, digest_view, per_rank, parked, clean):
+def _classify(expected, dead, digest_view, per_rank, parked, clean,
+              evicted=()):
     parked_ops = sorted({op for _s, op in parked.values()})
     failed = {r: i["failed"] for r, i in per_rank.items()
               if i.get("failed")}
@@ -227,6 +239,29 @@ def _classify(expected, dead, digest_view, per_rank, parked, clean):
         return "desync", digest_view.get("detail") or (
             f"ranks {digest_view['desynced']} diverged from the majority "
             "collective schedule")
+    if evicted:
+        # planned drain, not a failure: the eviction dump is the proof
+        # the rank exited on purpose — never report it as dead/hung
+        kinds, outcomes = [], []
+        for r in evicted:
+            ev = per_rank[r].get("preempt") or {}
+            if ev.get("kind"):
+                kinds.append(str(ev["kind"]))
+            if ev.get("outcome"):
+                outcomes.append(f"rank {r}: {ev['outcome']}")
+        why = (f"rank(s) {list(evicted)} ran the graceful-eviction path "
+               "(preemption notice -> bounded grace commit -> clean "
+               "exit; elastic/preempt.py)")
+        if kinds:
+            why += f"; notice kind(s): {'/'.join(sorted(set(kinds)))}"
+        if outcomes:
+            why += f"; commit outcome(s): {', '.join(outcomes)}"
+        bystanders = sorted(set(parked) - set(evicted))
+        if bystanders:
+            why += (f"; rank(s) {bystanders} were parked in "
+                    f"{'/'.join(parked_ops)} awaiting the next rendezvous "
+                    "when their dump fired")
+        return "graceful eviction", why
     if len(clean) == len(per_rank) and per_rank:
         return "healthy", "every rank dumped on a clean exit path with " \
                           "no collective left open"
@@ -276,7 +311,7 @@ def _fmt_event(ev):
     parts = [f"{ev.get('t', 0):.6f}", f"rank {ev.get('rank')}",
              str(ev.get("k"))]
     for key in ("ph", "seq", "op", "name", "step", "reason", "signum",
-                "epoch", "batch", "source"):
+                "epoch", "batch", "source", "kind", "outcome"):
         if ev.get(key) is not None:
             parts.append(f"{key}={ev[key]}")
     if ev.get("ok") is False:
@@ -297,7 +332,13 @@ def format_report(report):
     add(f"last common collective_seq: {report['last_common_seq']}")
     for r, info in sorted(report["per_rank"].items()):
         state = ""
-        if info["parked"]:
+        if info.get("evicted"):
+            ev = info.get("preempt") or {}
+            state = ("EVICTED"
+                     + (f" ({ev.get('kind')}" if ev.get("kind") else "")
+                     + (f", commit {ev['outcome']})" if ev.get("outcome")
+                        else (")" if ev.get("kind") else "")))
+        elif info["parked"]:
             seq, op = info["parked"]
             state = f"PARKED in {op} (seq {seq})"
         elif info.get("failed"):

@@ -281,6 +281,50 @@ def _default_dump_dir():
 
 _recorder = None
 
+# signum -> [callable]: consumers that CLAIM a signal (graceful eviction,
+# elastic/preempt.py). A claimed signal changes the termination contract:
+# the main-thread handler dumps and returns (no re-raise of the fatal
+# default), the watcher dumps, runs every listener on its own thread
+# (free to block — it is not a signal context), and skips the failsafe
+# SIGKILL. The listener owns process termination from that point.
+_signal_listeners = {}
+
+
+def add_signal_listener(signum, fn):
+    """Register ``fn(signum)`` to run on the wakeup-fd WATCHER thread
+    when ``signum`` arrives. This is how the graceful-eviction handler
+    rides the recorder's signal path: the C-level handler writes the
+    signal number to the pipe regardless of what the main thread is
+    doing, so a rank parked in a native collective still runs its
+    bounded grace commit. Registering claims the signal (see
+    ``_signal_listeners``)."""
+    _signal_listeners.setdefault(int(signum), []).append(fn)
+
+
+def remove_signal_listener(signum, fn):
+    fns = _signal_listeners.get(int(signum))
+    if not fns:
+        return
+    try:
+        fns.remove(fn)
+    except ValueError:
+        return
+    if not fns:
+        _signal_listeners.pop(int(signum), None)
+
+
+def _listeners_for(signum):
+    return list(_signal_listeners.get(int(signum), ()))
+
+
+def signal_watcher_active():
+    """True when the wakeup-fd watcher thread is installed and alive —
+    the precondition for :func:`add_signal_listener` actually firing.
+    Consumers fall back to their own ``signal.signal`` path otherwise."""
+    hooks = _hooks
+    t = hooks.get("watcher") if hooks else None
+    return t is not None and t.is_alive()
+
 
 def get_recorder():
     return _recorder
@@ -446,6 +490,12 @@ def _install_signal_path(rec, hooks, signals):
             rec.record("signal", signum=int(signum))
             rec.dump(reason=f"signal:{signum}")
             rec.wait_for_dump()
+            if _listeners_for(signum):
+                # a listener claimed this signal (graceful eviction):
+                # the watcher runs it and the listener owns termination
+                # — re-raising the fatal default here would kill the
+                # process mid-grace-commit
+                return
             if _prev is signal.SIG_IGN:
                 return  # the app chose to survive this signal; honor it
             if callable(_prev):
@@ -476,6 +526,18 @@ def _install_signal_path(rec, hooks, signals):
                     continue
                 rec.record("signal", signum=int(b), via="watcher")
                 rec.dump(reason=f"signal:{b}")
+                listeners = _listeners_for(b)
+                for fn in listeners:
+                    try:
+                        fn(int(b))
+                    # hvd-lint: disable=HVD-EXCEPT -- a listener must not kill the watcher
+                    except Exception:
+                        logger.warning("signal listener failed",
+                                       exc_info=True)
+                if listeners:
+                    # the listener owns termination (bounded grace
+                    # commit, then exit) — no failsafe kill
+                    continue
                 if b in fatal_by_default:
                     # the default disposition should already have killed
                     # us; if the main thread is parked in native code the
@@ -505,6 +567,7 @@ def uninstall(dump=True, reason="shutdown"):
         rec.dump(reason=reason)
     _recorder = None
     _hooks = None
+    _signal_listeners.clear()
     if hooks is None:
         return
     hooks["stop"].set()

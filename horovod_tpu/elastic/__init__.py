@@ -13,7 +13,11 @@ primitives (see docs/ELASTIC.md):
   over ``run/allocation.py``, failure blame with exponential backoff,
 * **notification** — the driver-to-worker interrupt plane (HMAC-framed
   TCP, same wire format as ``run/discovery.py``),
-* **runner** — the ``@hvd.elastic.run`` retry loop.
+* **runner** — the ``@hvd.elastic.run`` retry loop,
+* **preempt** — graceful eviction on spot capacity: SIGTERM / cloud
+  spot-notice → bounded force-commit → doomed-host announcement → clean
+  exit (``GracefulEvictionHandler``; docs/ELASTIC.md "Running on spot
+  capacity").
 
 Typical worker::
 
@@ -31,6 +35,7 @@ Typical worker::
     train(state)
 """
 
+from horovod_tpu.elastic import preempt
 from horovod_tpu.elastic.discovery import (FixedHosts, HostDiscovery,
                                            HostDiscoveryPoller,
                                            HostUpdateResult,
@@ -44,6 +49,7 @@ from horovod_tpu.elastic.notification import (WorkerNotificationClient,
                                               WorkerNotificationManager,
                                               WorkerNotificationService,
                                               notification_manager)
+from horovod_tpu.elastic.preempt import GracefulEvictionHandler
 from horovod_tpu.elastic.runner import run
 from horovod_tpu.elastic.state import JaxState, ObjectState, State
 from horovod_tpu.elastic.worker import (WorkerContext,
@@ -65,4 +71,5 @@ __all__ = [
     "shutdown_worker_context", "attach_progress_reporter",
     "is_elastic_worker",
     "run",
+    "preempt", "GracefulEvictionHandler",
 ]

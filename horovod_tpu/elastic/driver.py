@@ -30,6 +30,7 @@ import time
 from horovod_tpu.diag import desync as desync_lib
 from horovod_tpu.elastic.discovery import HostDiscoveryPoller
 from horovod_tpu.elastic.notification import WorkerNotificationClient
+from horovod_tpu.elastic.preempt import DOOMED_KEY_PREFIX, DOOMED_MARKER_KEY
 from horovod_tpu.run import allocation
 from horovod_tpu.telemetry import get_registry
 from horovod_tpu.telemetry import instruments as _tele
@@ -45,6 +46,20 @@ STRAGGLER_THRESHOLD = 2.0
 # the driver can tell a graceful world change from a crash.
 EXIT_RENDEZVOUS = 75
 
+# A heartbeat younger than this marks its host "healthy" in the
+# cluster view — the sustained-health evidence that decays blacklist
+# failure counts.
+HEALTHY_HEARTBEAT_S = 30.0
+
+# Default unbroken-health window that forgives one below-threshold
+# failure (driver-constructed Blacklists; pass your own to override).
+BLACKLIST_DECAY_WINDOW_S = 300.0
+
+# A doomed-host announcement older than this is stale: the spot host
+# either already died (and discovery dropped it) or came back — it must
+# not stay excluded forever on a leftover key.
+DOOMED_TTL_S = 120.0
+
 
 class Blacklist:
     """Failure accounting per host (reference ``ElasticDriver``'s
@@ -52,21 +67,39 @@ class Blacklist:
     exponentially growing backoff window; after ``threshold`` failures it
     is excluded permanently.
 
-    ``clock`` is injectable so tests can drive the backoff without
-    sleeping."""
+    Two refinements over the reference for spot capacity:
+
+    * **drained ≠ crashed** — a host whose eviction was announced on the
+      KV (``elastic/preempt.py``) departs via :meth:`record_drain`,
+      which carries no penalty: preemption is the *plan* on spot
+      capacity, and penalizing it would walk every host toward
+      permanent exclusion.
+    * **decay on sustained health** — with ``decay_window`` set, each
+      unbroken window of observed health (:meth:`observe_health`, fed by
+      the driver's ``cluster_view()`` heartbeat freshness) forgives one
+      below-threshold failure, so a host that flapped once is not one
+      failure from permanent exclusion for the life of a week-long run.
+      Permanent blacklisting never decays.
+
+    ``clock`` is injectable so tests can drive the backoff and the decay
+    without sleeping."""
 
     def __init__(self, threshold=3, base_delay=5.0, max_delay=600.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, decay_window=None):
         self._threshold = threshold
         self._base = base_delay
         self._max = max_delay
         self._clock = clock
+        self._decay_window = decay_window
         self._failures = {}   # host -> count
         self._cooldown = {}   # host -> excluded-until timestamp
+        self._drains = {}     # host -> graceful-departure count
+        self._healthy_anchor = {}  # host -> start of current health streak
 
     def record_failure(self, host):
         n = self._failures.get(host, 0) + 1
         self._failures[host] = n
+        self._healthy_anchor.pop(host, None)  # a failure breaks the streak
         delay = min(self._base * (2 ** (n - 1)), self._max)
         self._cooldown[host] = self._clock() + delay
         if n >= self._threshold:
@@ -77,8 +110,50 @@ class Blacklist:
                            "%.1fs", host, n, self._threshold, delay)
         return n
 
+    def record_drain(self, host):
+        """A planned departure (graceful eviction announced on the KV):
+        counted for observability, zero blacklist penalty."""
+        n = self._drains.get(host, 0) + 1
+        self._drains[host] = n
+        logger.info("elastic: host %s drained gracefully (%d drain(s), "
+                    "no penalty)", host, n)
+        return n
+
+    def observe_health(self, hosts, now=None):
+        """Feed sustained-health evidence: ``hosts`` is the set observed
+        healthy right now (fresh heartbeats in ``cluster_view()``). A
+        host absent from consecutive observations loses its streak; each
+        full ``decay_window`` of unbroken presence forgives one
+        below-threshold failure. No-op without ``decay_window``."""
+        if not self._decay_window:
+            return
+        now = self._clock() if now is None else now
+        hosts = set(hosts)
+        for host in list(self._healthy_anchor):
+            if host not in hosts:
+                del self._healthy_anchor[host]
+        for host in hosts:
+            anchor = self._healthy_anchor.setdefault(host, now)
+            n = self._failures.get(host, 0)
+            if n <= 0 or n >= self._threshold:
+                continue
+            if now - anchor >= self._decay_window:
+                n -= 1
+                self._healthy_anchor[host] = now
+                if n <= 0:
+                    self._failures.pop(host, None)
+                    self._cooldown.pop(host, None)
+                else:
+                    self._failures[host] = n
+                logger.info("elastic: host %s healthy for %.0fs — failure "
+                            "count decayed to %d", host,
+                            self._decay_window, n)
+
     def count(self, host):
         return self._failures.get(host, 0)
+
+    def drains(self, host):
+        return self._drains.get(host, 0)
 
     def blacklisted(self, host):
         """Permanently excluded (failure count reached the threshold)."""
@@ -111,7 +186,8 @@ class ElasticDriver:
 
     def __init__(self, discovery, min_np, max_np=None, blacklist=None,
                  kv=None, auth_key=None, poll_interval=1.0, timeline=None,
-                 start_timeout=600, hopeless_grace=30.0):
+                 start_timeout=600, hopeless_grace=30.0,
+                 doomed_ttl=DOOMED_TTL_S):
         if min_np < 1:
             raise ValueError(f"min_np must be >= 1 (got {min_np})")
         if max_np is not None and max_np < min_np:
@@ -119,7 +195,9 @@ class ElasticDriver:
                 f"max_np ({max_np}) must be >= min_np ({min_np})")
         self.min_np = min_np
         self.max_np = max_np
-        self.blacklist = blacklist if blacklist is not None else Blacklist()
+        self.blacklist = blacklist if blacklist is not None else Blacklist(
+            decay_window=BLACKLIST_DECAY_WINDOW_S)
+        self._doomed_ttl = doomed_ttl
         self._kv = kv
         self._auth_key = auth_key
         self._timeline = timeline
@@ -153,6 +231,14 @@ class ElasticDriver:
             _tele.GOODPUT_RATIO, "Fleet-wide goodput: summed compute "
             "seconds / summed attributed seconds across the workers' "
             "per-rank goodput ledgers (KV heartbeat snapshots)")
+        self._m_preempt = reg.counter(
+            _tele.PREEMPTIONS_TOTAL, "Preemption notices acted on, by "
+            "source kind (docs/OBSERVABILITY.md)",
+            label_names=("kind",))
+        self._m_drain = reg.histogram(
+            _tele.DRAIN_SECONDS, "Doomed-host announcement to the "
+            "rendezvous that drained (or knowingly reused) the host — "
+            "the wall cost of planned churn")
 
     # -- membership ----------------------------------------------------------
     def available_hosts(self):
@@ -205,6 +291,66 @@ class ElasticDriver:
             time.sleep(min(self._poll_interval,
                            max(0.05, effective - time.monotonic())))
             self._poller.poll_once()
+
+    def _read_doomed(self):
+        """Fresh doomed-host announcements (``elastic/doomed/<host>``,
+        published by evicted workers — elastic/preempt.py), keyed by
+        host. Stale entries (older than ``doomed_ttl``) are dropped and
+        deleted: a reclaimed spot host that came back must not stay
+        excluded on a leftover key."""
+        if self._kv is None:
+            return {}
+        hosts = set(self._poller.current()) | {
+            s.hostname for s in self._current_slots}
+        doomed = {}
+        for host in sorted(hosts):
+            raw = self._kv.get(DOOMED_KEY_PREFIX + host)
+            if raw is None:
+                continue
+            try:
+                info = json.loads(raw)
+            except (ValueError, UnicodeDecodeError):
+                info = {}
+            ts = float(info.get("time") or 0)
+            if ts and abs(time.time() - ts) > self._doomed_ttl:
+                self._kv.delete(DOOMED_KEY_PREFIX + host)
+                continue
+            doomed[host] = info
+        return doomed
+
+    def _consume_doomed(self, hosts):
+        """Drain announced-doomed hosts from the rendezvous about to
+        open — the point of the announcement: the host leaves the world
+        BEFORE its death breaks a collective. One-shot (the keys are
+        consumed here). When excluding every doomed host would drop
+        below ``min_np`` the hosts are knowingly reused — a doomed host
+        that has not died yet beats failing the job, and the
+        announcement still bought no-blame drain accounting."""
+        doomed = self._read_doomed()
+        if not doomed:
+            return {}
+        kept = {h: s for h, s in hosts.items() if h not in doomed}
+        if sum(kept.values()) >= self.min_np:
+            for host in doomed:
+                hosts.pop(host, None)
+            logger.info("elastic: draining doomed host(s) %s from the "
+                        "next rendezvous", sorted(doomed))
+        else:
+            logger.warning(
+                "elastic: doomed host(s) %s announced but the remaining "
+                "capacity is below min_np=%d; knowingly reusing them",
+                sorted(doomed), self.min_np)
+        now = time.time()
+        for host, info in doomed.items():
+            self._kv.delete(DOOMED_KEY_PREFIX + host)
+            ts = float(info.get("time") or now)
+            self._m_preempt.labels(info.get("kind") or "sigterm").inc()
+            self._m_drain.observe(max(0.0, now - ts))
+        self._kv.delete(DOOMED_MARKER_KEY)
+        self._membership_event(
+            "DRAIN", {"epoch": self.epoch, "hosts": sorted(doomed),
+                      "reused": sum(kept.values()) < self.min_np})
+        return doomed
 
     def _on_hosts_updated(self, added, removed, current, res):
         logger.info("elastic: host set changed (added=%s removed=%s)",
@@ -292,6 +438,18 @@ class ElasticDriver:
         progress = self.worker_progress()
         view = {"epoch": self.epoch, "ranks": {}, "stragglers": [],
                 "straggler_ratio": None, "goodput": None}
+        # sustained-health evidence for blacklist decay: a fresh
+        # heartbeat marks the rank's host healthy this observation
+        now_wall = time.time()
+        healthy_hosts = set()
+        for slot in self._current_slots:
+            hb = progress.get(slot.rank)
+            if hb and now_wall - float(hb.get("time") or 0) \
+                    <= HEALTHY_HEARTBEAT_S:
+                healthy_hosts.add(slot.hostname)
+        if healthy_hosts:
+            self.blacklist.observe_health(healthy_hosts)
+        view["healthy_hosts"] = sorted(healthy_hosts)
         step_times = {}
         fleet_phases = {}
         for rank, hb in progress.items():
@@ -379,6 +537,7 @@ class ElasticDriver:
         the current host set (capped at max-np), publish the assignment.
         Returns the slot list."""
         hosts = self.wait_for_available_slots(self.min_np)
+        self._consume_doomed(hosts)
         host_list = [allocation.HostSlots(h, s)
                      for h, s in sorted(hosts.items())]
         total = sum(h.slots for h in host_list)
@@ -456,13 +615,27 @@ class ElasticDriver:
                                 self.epoch)
                     return self.epoch
                 rank, rc = first
+                doomed = self._read_doomed()
                 if rc == EXIT_RENDEZVOUS:
                     # graceful: workers drained at a commit boundary in
                     # response to a membership interrupt — no blame. A
                     # drain with NO membership change behind it means the
                     # command exits 75 on its own: cap it, or hvdrun
                     # would relaunch in a tight infinite loop.
-                    if self._membership_dirty:
+                    if doomed:
+                        # planned churn: an evicted worker announced its
+                        # host before exiting (elastic/preempt.py) —
+                        # blame nobody; the next rendezvous consumes the
+                        # announcement and drains the host
+                        spurious_drains = 0
+                        for h in sorted(doomed):
+                            self.blacklist.record_drain(h)
+                        logger.info(
+                            "elastic: epoch %d graceful eviction of %s "
+                            "(kind=%s)", self.epoch, sorted(doomed),
+                            sorted({(d.get("kind") or "sigterm")
+                                    for d in doomed.values()}))
+                    elif self._membership_dirty:
                         self._membership_dirty = False
                         spurious_drains = 0
                     else:
@@ -485,10 +658,21 @@ class ElasticDriver:
                     "elastic: epoch %d rank %d on %s exited with %s "
                     "(last heartbeat: %s)", self.epoch, rank, host, rc,
                     self.worker_progress().get(rank))
-                self.blacklist.record_failure(host)
-                self._membership_event(
-                    "FAILURE", {"epoch": self.epoch, "rank": rank,
-                                "host": host, "exit_code": rc})
+                if host in doomed:
+                    # the doomed host died before finishing its clean
+                    # exit (SIGKILL beat the grace window) — still
+                    # PLANNED churn: drain accounting, no backoff that
+                    # would penalize the next rendezvous
+                    self.blacklist.record_drain(host)
+                    self._membership_event(
+                        "DRAIN", {"epoch": self.epoch, "rank": rank,
+                                  "host": host, "exit_code": rc,
+                                  "crashed_in_grace": True})
+                else:
+                    self.blacklist.record_failure(host)
+                    self._membership_event(
+                        "FAILURE", {"epoch": self.epoch, "rank": rank,
+                                    "host": host, "exit_code": rc})
         finally:
             monitor_stop.set()
             self._poller.stop()

@@ -43,8 +43,12 @@ def run(func=None, *, retryable=()):
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        from horovod_tpu.elastic import preempt as _preempt
         from horovod_tpu.elastic.driver import EXIT_RENDEZVOUS
         from horovod_tpu.telemetry import ledger as ledger_lib
+        # an armed eviction handler (runtime/services.py) force-commits
+        # THIS state's in-flight save inside the grace window
+        _preempt.attach_state(state)
         reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT",
                                          "0") or 0)
         resets = 0

@@ -12,10 +12,28 @@ import signal
 import subprocess
 import sys
 import threading
+import time
 
 from horovod_tpu.run.secret import SECRET_ENV
 
 LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+# SIGTERM fan-out escalation: forwarded SIGTERM -> wait this many
+# seconds for workers to finish their graceful eviction (bounded grace
+# commit, elastic/preempt.py) -> SIGKILL survivors. Without the
+# escalation one worker ignoring SIGTERM parks the launcher forever.
+GRACE_ENV = "HOROVOD_GRACE_SECONDS"
+DEFAULT_GRACE_SECONDS = 30.0
+
+
+def grace_seconds(env=None):
+    raw = (env if env is not None else os.environ).get(GRACE_ENV)
+    if not raw:
+        return DEFAULT_GRACE_SECONDS
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return DEFAULT_GRACE_SECONDS
 
 
 def slot_env(slot, controller_addr, controller_port, rendezvous_addr=None,
@@ -150,6 +168,32 @@ class Job:
                 except OSError:
                     pass
 
+    def escalate_after_grace(self, grace=None, clock=time.monotonic,
+                             sleep=time.sleep):
+        """Wait up to ``grace`` seconds (``HOROVOD_GRACE_SECONDS``) for
+        every process to exit, then SIGKILL the survivors. Returns the
+        list of ranks killed. ``clock``/``sleep`` are injectable for
+        fake-clock tests."""
+        grace = grace_seconds() if grace is None else grace
+        deadline = clock() + grace
+        while clock() < deadline:
+            if all(p.poll() is not None for p in self.procs):
+                return []
+            sleep(min(0.2, max(0.01, deadline - clock())))
+        killed = []
+        for rank, p in enumerate(self.procs):
+            if p.poll() is None:
+                try:
+                    p.kill()
+                    killed.append(rank)
+                except OSError:
+                    pass
+        if killed:
+            sys.stderr.write(
+                f"hvdrun: rank(s) {killed} survived SIGTERM past the "
+                f"{grace:.0f}s grace deadline; SIGKILLed\n")
+        return killed
+
     def _monitor(self, rank, proc):
         rc = proc.wait()
         with self._lock:
@@ -256,6 +300,14 @@ def launch(slots, command, controller_addr, controller_port,
     if threading.current_thread() is threading.main_thread():
         def _forward(signum, frame):
             job.kill_all(signum)
+            # escalation on its OWN NON-daemon thread: the handler must
+            # stay non-blocking (HVD-SIGSAFE), and the thread must
+            # survive the SystemExit below — the interpreter waits for
+            # non-daemon threads, which is exactly what lets it SIGKILL
+            # a worker that ignores the forwarded SIGTERM; the thread
+            # self-terminates within the grace budget either way
+            threading.Thread(target=job.escalate_after_grace,
+                             name="hvd_tpu_grace").start()
             sys.exit(128 + signum)
         try:
             signal.signal(signal.SIGTERM, _forward)

@@ -159,6 +159,14 @@ def build_parser():
                       choices=["trace", "debug", "info", "warning",
                                "error", "fatal"])
 
+    p.add_argument("--chaos", metavar="SPEC", default=None,
+                   help="arm a seeded fault injector against this job's "
+                        "worker processes (chaos soak, docs/ELASTIC.md): "
+                        "SPEC is a JSON plan file or an inline "
+                        "'seed=7,interval=2.5,kinds=sigterm+sigkill,"
+                        "count=6' knob list; kinds are sigterm/sigkill/"
+                        "stall/slow_disk. In elastic mode the remaining "
+                        "injections retarget each new epoch's workers")
     p.add_argument("--doctor", metavar="LOGDIR", default=None,
                    help="aggregate the flight-recorder dumps "
                         "(flightrec.rank*.json) under LOGDIR into one "
@@ -198,6 +206,12 @@ def parse_args(argv=None):
         defaults = {a.dest: a.default for a in parser._actions}
         config_parser.load_config_file(args.config_file, args, defaults)
     args.elastic = _validate_elastic_args(parser, args)
+    if args.chaos is not None:
+        from horovod_tpu.chaos import parse_spec
+        try:
+            parse_spec(args.chaos)  # reject malformed specs pre-launch
+        except ValueError as e:
+            parser.error(str(e))
     # after the config overlay: the YAML may supply num-proc
     if (not args.check_build and not args.elastic
             and args.merge_timeline is None and args.doctor is None
@@ -458,6 +472,15 @@ def _cleanup_tmp_flightrec(tmp_dir):
     shutil.rmtree(tmp_dir, ignore_errors=True)
 
 
+def _start_chaos(args):
+    """Build (but do not arm) the fault injector for --chaos: the monkey
+    starts its clock at the first ``attach()``, i.e. once workers exist."""
+    if args.chaos is None:
+        return None
+    from horovod_tpu.chaos import ChaosMonkey, parse_spec
+    return ChaosMonkey(parse_spec(args.chaos))
+
+
 def _run(args):
     if not args.command:
         raise SystemExit("hvdrun: no training command given")
@@ -497,6 +520,9 @@ def _run(args):
                           controller_port, rendezvous_port=rendezvous_port,
                           extra_env=extra_env, ssh_port=args.ssh_port,
                           output_dir=args.output_dir)
+    monkey = _start_chaos(args)
+    if monkey is not None:
+        monkey.attach(job)
     try:
         job.wait()
         _cleanup_tmp_flightrec(tmp_dump_dir)
@@ -504,6 +530,8 @@ def _run(args):
         _maybe_doctor(args, dump_dir, multi_host=not all_local)
         raise
     finally:
+        if monkey is not None:
+            monkey.stop()
         kv.stop()
 
 
@@ -552,6 +580,15 @@ def _run_elastic(args):
         rendezvous_port=rendezvous_port, extra_env=extra_env,
         ssh_port=args.ssh_port, output_dir=args.output_dir,
         jax_coordinator=args.jax_coordinator)
+    monkey = _start_chaos(args)
+    if monkey is not None:
+        inner_launch = launch
+
+        def launch(slots, epoch, env):
+            # retarget the remaining injections at THIS epoch's workers
+            job = inner_launch(slots, epoch, env)
+            monkey.attach(job)
+            return job
     dump_dir, tmp_dump_dir = _flightrec_dir(args, extra_env)
     try:
         epochs = driver.run_job(launch)
@@ -563,6 +600,8 @@ def _run_elastic(args):
         _maybe_doctor(args, dump_dir, multi_host=not all_local)
         raise
     finally:
+        if monkey is not None:
+            monkey.stop()
         driver.stop()
         kv.stop()
 

@@ -163,6 +163,20 @@ def start(state):
                 logger.warning("elastic worker context failed to attach",
                                exc_info=True)
         state.stall_inspector.start()
+    # graceful eviction (elastic/preempt.py): armed for driver-managed
+    # elastic workers, and for any run that opted in with a grace budget
+    # or a spot-notice source in the env — installed AFTER the recorder
+    # so SIGTERM rides its wakeup-fd watcher
+    from horovod_tpu.elastic import preempt as _preempt
+    if elastic or _preempt.configured():
+        try:
+            state.preempt_handler = _preempt.install()
+            logger.info("graceful-eviction handler armed (grace %.0fs)",
+                        _preempt.grace_seconds())
+        # hvd-lint: disable=HVD-EXCEPT -- eviction is best-effort armor, not a startup dependency
+        except Exception:
+            logger.warning("graceful-eviction handler failed to install",
+                           exc_info=True)
 
 
 def stop(state):
@@ -183,6 +197,10 @@ def stop(state):
     # hvd-lint: disable=HVD-EXCEPT -- shutdown path: the ledger dump is best-effort
     except Exception:
         logger.warning("goodput ledger dump failed", exc_info=True)
+    if getattr(state, "preempt_handler", None) is not None:
+        from horovod_tpu.elastic import preempt as _preempt
+        _preempt.uninstall()
+        state.preempt_handler = None
     if state.metrics_server is not None:
         state.metrics_server.stop()
         state.metrics_server = None

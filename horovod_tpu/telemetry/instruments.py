@@ -53,6 +53,10 @@ RENDEZVOUS_EPOCHS = "hvd_rendezvous_epochs_total"
 BLACKLIST_HOSTS = "hvd_blacklist_hosts"
 RECOVERY_SECONDS = "hvd_recovery_seconds"
 STRAGGLER_RATIO = "hvd_straggler_step_time_ratio"
+# -- preemption / graceful eviction (elastic/preempt.py, chaos soak) --------
+PREEMPTIONS_TOTAL = "hvd_preemptions_total"
+DRAIN_SECONDS = "hvd_drain_seconds"
+GRACE_COMMIT_SECONDS = "hvd_grace_commit_seconds"
 # -- stall inspector --------------------------------------------------------
 STALLED_RANKS = "hvd_stalled_ranks"
 # -- async sharded checkpointing (horovod_tpu/ckpt) -------------------------
@@ -116,6 +120,7 @@ CATALOGUE = (
     WIRE_BYTES, WIRE_LOGICAL_BYTES, WIRE_COMPRESSION_RATIO,
     BUCKET_FILL_RATIO, BUCKET_DISPATCH_SECONDS,
     RENDEZVOUS_EPOCHS, BLACKLIST_HOSTS, RECOVERY_SECONDS, STRAGGLER_RATIO,
+    PREEMPTIONS_TOTAL, DRAIN_SECONDS, GRACE_COMMIT_SECONDS,
     STALLED_RANKS,
     CKPT_BLOCKING_SECONDS, CKPT_SAVE_SECONDS, CKPT_BYTES_WRITTEN,
     CKPT_INFLIGHT,

@@ -23,6 +23,10 @@ Phases (exclusive — each second lands in exactly one):
 * ``compile``             — XLA compilation (jax.monitoring durations).
 * ``rendezvous_recovery`` — elastic recovery: rollback, restore from
   checkpoint, re-rendezvous sync.
+* ``preemption``          — planned-churn cost: the graceful-eviction
+  window (bounded grace commit + doomed-host announcement) when a spot
+  notice / SIGTERM evicts this rank (``elastic/preempt.py``), and the
+  scripted eviction spans of ``bench.py --churn``.
 * ``stall_idle``          — unattributed gaps longer than
   ``IDLE_THRESHOLD_S`` settled outside a step (the job was parked and
   nothing claimed the time — the "something is wrong" bucket).
@@ -62,7 +66,8 @@ import time
 logger = logging.getLogger("horovod_tpu")
 
 PHASES = ("compute", "exposed_collective", "data_wait", "ckpt_stall",
-          "compile", "rendezvous_recovery", "stall_idle", "overhead")
+          "compile", "rendezvous_recovery", "preemption", "stall_idle",
+          "overhead")
 
 # an unattributed non-step gap at least this long is a stall, not
 # bookkeeping overhead

@@ -30,6 +30,7 @@ setup(
                  "C++ host core, MPI-free launcher)"),
     packages=["horovod_tpu", "horovod_tpu.analysis",
               "horovod_tpu.analysis.rules",
+              "horovod_tpu.chaos",
               "horovod_tpu.ckpt", "horovod_tpu.data",
               "horovod_tpu.diag", "horovod_tpu.elastic",
               "horovod_tpu.jax", "horovod_tpu.models",

@@ -1,0 +1,71 @@
+"""Worker body for the ``hvdrun --chaos`` soak (tests/test_chaos.py).
+
+Unlike elastic_train_worker.py (which wires its elastic context by
+hand), this worker goes through the full product path — ``hvd.init()``
+arms the flight recorder AND the graceful-eviction handler
+(runtime/services.py), so the chaos monkey's SIGTERM exercises the real
+preemption plane: recorder wakeup-fd watcher -> bounded grace commit ->
+doomed-host announcement -> clean EXIT_RENDEZVOUS.
+
+    argv: <ckpt_dir> <log_path> <num_steps>
+
+Deterministic scalar SGD (same oracle as elastic_train_worker.py); only
+rank 0 appends to the loss log. HVD_CHAOS_TEST_SLEEP paces the steps so
+the chaos schedule lands mid-training.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import elastic  # noqa: E402
+
+TARGET = 3.0
+LR = 0.2
+
+
+def main():
+    ckpt_dir, log_path, num_steps = (sys.argv[1], sys.argv[2],
+                                     int(sys.argv[3]))
+    step_sleep = float(os.environ.get("HVD_CHAOS_TEST_SLEEP", "0.05"))
+
+    hvd.init()
+    rank = hvd.rank()
+    epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+
+    state = elastic.JaxState(directory=ckpt_dir,
+                             params={"w": np.float64(0.0)},
+                             step=np.int64(0))
+
+    @elastic.run
+    def train(state):
+        while int(state.step) < num_steps:
+            if step_sleep:
+                time.sleep(step_sleep)
+            w = float(state.params["w"])
+            loss = (w - TARGET) ** 2
+            state.params = {"w": np.float64(w - LR * 2 * (w - TARGET))}
+            state.step = np.int64(int(state.step) + 1)
+            state.commit()
+            if rank == 0:
+                with open(log_path, "a") as f:
+                    f.write(json.dumps({"epoch": epoch,
+                                        "step": int(state.step),
+                                        "loss": loss}) + "\n")
+        return int(state.step)
+
+    final = train(state)
+    if rank == 0:
+        with open(log_path, "a") as f:
+            f.write(json.dumps({"epoch": epoch, "done": final}) + "\n")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -8,9 +8,13 @@ host that keeps dying:
     argv: <ckpt_dir> <log_path> <num_steps> [die_host [die_until_epoch]]
 
 A worker whose HOROVOD_HOSTNAME == die_host and epoch < die_until_epoch
-SIGKILLs itself after committing one step — the "worker killed
-mid-training" scenario. Only rank 0 appends to the loss log, so the log
-is the single continuous loss trajectory across incarnations.
+dies after committing one step — the "worker killed mid-training"
+scenario. HVD_ELASTIC_TEST_DIE picks how: ``kill`` (default) SIGKILLs
+itself; ``evict`` arms the graceful-eviction handler
+(elastic/preempt.py) and SIGTERMs itself, so the death runs the planned
+drain — announce, bounded commit, EXIT_RENDEZVOUS. Only rank 0 appends
+to the loss log, so the log is the single continuous loss trajectory
+across incarnations.
 """
 
 import json
@@ -49,6 +53,14 @@ def main():
                              step=np.int64(0))
     entry_step = {"v": None}
 
+    die_mode = os.environ.get("HVD_ELASTIC_TEST_DIE", "kill")
+    if die_mode == "evict":
+        # the graceful counterpart of the SIGKILL below: SIGTERM lands in
+        # this handler, which announces the doomed host on the KV and
+        # force-commits inside the grace window before exiting
+        from horovod_tpu.elastic import preempt
+        preempt.install(state)
+
     step_sleep = float(os.environ.get("HVD_ELASTIC_TEST_SLEEP", "0") or 0)
 
     @elastic.run
@@ -70,6 +82,13 @@ def main():
                                         "step": int(state.step),
                                         "loss": loss}) + "\n")
             if (die_host and host == die_host and epoch < die_until_epoch):
+                if die_mode == "evict":
+                    # a spot preemption notice: the eviction thread owns
+                    # the rest of this process's life (commit + exit 75);
+                    # park here so no further step races the drain
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    time.sleep(60)
+                    raise SystemExit("eviction never fired")
                 # commits are ASYNC now (horovod_tpu/ckpt): the scenario
                 # is "crash strikes after the checkpoint reached
                 # durability", so force the in-flight save to its

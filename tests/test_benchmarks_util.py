@@ -208,6 +208,49 @@ def test_spmd_bench_mode_is_exclusive():
     assert "--spmd is its own comparison mode" in proc.stderr
 
 
+def test_churn_bench_mode_is_exclusive():
+    """bench.py --churn is its own comparison mode (the goodput-under-
+    churn SLO gate): combining it with --overlap etc. dies at parsing."""
+    import subprocess
+    import sys
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--churn", "--overlap"],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "--churn is its own comparison mode" in proc.stderr
+
+
+def test_churn_slo_gate_smoke():
+    """ISSUE 15 acceptance: ``bench.py --churn`` runs a scripted
+    preemption schedule, attributes every lost second (non-zero
+    preemption lane, sum≈wall), and PASSes its goodput budget."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--churn", "--churn-steps", "24",
+         "--churn-preemptions", "2", "--churn-budget", "0.05",
+         "--churn-drain-ms", "10"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.splitlines()[-1])
+    assert out["metric"] == "goodput_under_churn"
+    assert out["slo"] == "PASS"
+    assert out["preemptions"] == 2
+    assert len(out["preempted_at_steps"]) == 2
+    goodput = out["goodput"]
+    assert goodput["phases"]["preemption"] > 0  # churn is attributed...
+    assert sum(goodput["phases"].values()) == pytest.approx(
+        goodput["wall_seconds"], rel=0.02)  # ...and nothing is lost
+    assert out["value"] >= out["budget"]
+
+
 def test_goodput_block_invariant_validation():
     """The BENCH `goodput` block contract (ISSUE 9 satellite): the phase
     sum must explain ~100% of wall time — an unattributed gap >2% (or a

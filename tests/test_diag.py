@@ -282,6 +282,36 @@ def test_doctor_compile_stall_classification(tmp_path):
     assert report["classification"] == "compile stall"
 
 
+def test_doctor_graceful_eviction_classification(tmp_path):
+    """A preempted rank's eviction dump must classify as a planned
+    drain — never as a dead/hung rank — even while a bystander rank
+    sits parked in a collective waiting for the next rendezvous."""
+    def evicted(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 4)
+        rec.record("preempt", kind="sigterm", signum=15, host="spot-a",
+                   grace=5.0)
+        rec.record("preempt", kind="sigterm", outcome="committed",
+                   announced=True, commit_seconds=0.4)
+        rec.dump(reason="preempt")
+
+    def bystander(rec, clk):
+        drive_schedule(rec, clk, [("allreduce", (4,))] * 4)
+        drive_schedule(rec, clk, [("allreduce", (4,))], complete=False)
+        rec.dump(reason="stall")
+
+    dumps = _dump_ranks(tmp_path, {0: evicted, 1: bystander})
+    report = doctor.diagnose(dumps)
+    assert report["classification"] == "graceful eviction"
+    assert report["evicted_ranks"] == [0]
+    assert report["per_rank"][0]["evicted"]
+    assert report["per_rank"][0]["preempt"]["outcome"] == "committed"
+    assert not report["per_rank"][1]["evicted"]
+    text = doctor.format_report(report)
+    assert "EVICTED" in text
+    assert "sigterm" in text
+    assert "probable cause: graceful eviction" in text
+
+
 def test_doctor_healthy_classification(tmp_path):
     def clean(rec, clk):
         drive_schedule(rec, clk, [("allreduce", (4,))] * 2)

@@ -93,6 +93,26 @@ def test_phase_bracket_books_elapsed_minus_inner_charges():
     assert sum(snap["phases"].values()) == pytest.approx(3.0)
 
 
+def test_preemption_lane_attributes_eviction_time():
+    """ISSUE 15: the ledger has a first-class ``preemption`` lane — the
+    eviction handler's announce + grace-commit bracket lands there, so
+    churn seconds are attributed, never 'unattributed'."""
+    assert "preemption" in PHASES
+    led, t = fake_ledger()
+    led.start()
+    t[0] = 1.0
+    with led.phase("preemption"):
+        t[0] = 1.4  # announce + bounded force-commit
+    led.finalize()
+    snap = led.snapshot()
+    assert snap["phases"]["preemption"] == pytest.approx(0.4)
+    assert snap["unattributed_seconds"] == pytest.approx(0.0)
+    assert sum(snap["phases"].values()) == \
+        pytest.approx(snap["wall_seconds"])
+    block = report_mod.goodput_block(ledger=led)
+    assert block["phases"]["preemption"] == pytest.approx(0.4)
+
+
 def test_settle_mid_bracket_accounts_open_span():
     """A scrape-time settle while a rank is parked in recovery books the
     elapsed bracket time instead of leaving it unattributed."""

@@ -55,6 +55,65 @@ def test_allocate_too_many():
         allocation.allocate(allocation.parse_hosts("h1:2"), 3)
 
 
+# ---- SIGTERM fan-out escalation (ISSUE 15) -----------------------------
+
+class _FakeProc:
+    def __init__(self):
+        self.rc = None
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+
+def test_escalate_after_grace_kills_only_survivors():
+    """Fake clock: one proc drains inside the grace window, one ignores
+    the SIGTERM — only the survivor is SIGKILLed, and its rank is
+    reported."""
+    now = {"t": 0.0}
+    drains, stubborn = _FakeProc(), _FakeProc()
+
+    def sleep(dt):
+        now["t"] += dt
+        if now["t"] >= 2.0 and drains.rc is None:
+            drains.rc = 75  # a clean grace-commit exit mid-window
+
+    job = launcher.Job()
+    job.procs = [drains, stubborn]
+    killed = job.escalate_after_grace(grace=10.0,
+                                      clock=lambda: now["t"], sleep=sleep)
+    assert killed == [1]
+    assert stubborn.killed and not drains.killed
+    assert now["t"] >= 10.0  # the full grace was honored first
+
+
+def test_escalate_after_grace_noop_when_all_exit():
+    now = {"t": 0.0}
+    a, b = _FakeProc(), _FakeProc()
+
+    def sleep(dt):
+        now["t"] += dt
+        a.rc = b.rc = 0
+
+    job = launcher.Job()
+    job.procs = [a, b]
+    killed = job.escalate_after_grace(grace=30.0,
+                                      clock=lambda: now["t"], sleep=sleep)
+    assert killed == []
+    assert not a.killed and not b.killed
+    assert now["t"] < 30.0  # returns as soon as everyone is gone
+
+
+def test_launcher_grace_seconds_env():
+    assert launcher.grace_seconds({}) == 30.0
+    assert launcher.grace_seconds({"HOROVOD_GRACE_SECONDS": "7"}) == 7.0
+    assert launcher.grace_seconds({"HOROVOD_GRACE_SECONDS": "bad"}) == 30.0
+
+
 # ---- CLI / env mapping (reference test_run.py:68-233) ------------------
 
 def test_args_to_env():

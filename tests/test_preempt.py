@@ -1,0 +1,513 @@
+"""Preemption-native spot training (ISSUE 15): the graceful-eviction
+handler (elastic/preempt.py), the doomed-host plane through the elastic
+driver, drained-vs-crashed blame accounting, and blacklist decay on
+sustained health. Fast tier runs on fake clocks / a loopback KV; the
+drained-vs-SIGKILL recovery-cost comparison spawns real workers and is
+slow-marked."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic import preempt
+from horovod_tpu.elastic.discovery import FixedHosts
+from horovod_tpu.elastic.driver import (DOOMED_TTL_S, EXIT_RENDEZVOUS,
+                                        Blacklist, ElasticDriver)
+from horovod_tpu.elastic.preempt import (DOOMED_KEY_PREFIX,
+                                         DOOMED_MARKER_KEY,
+                                         GracefulEvictionHandler)
+from horovod_tpu.run import launcher
+from horovod_tpu.run.rendezvous import KVStoreServer
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_train_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------------
+
+def test_grace_seconds_env_parsing():
+    assert preempt.grace_seconds({}) == preempt.DEFAULT_GRACE_SECONDS
+    assert preempt.grace_seconds({"HOROVOD_GRACE_SECONDS": "12.5"}) == 12.5
+    assert preempt.grace_seconds({"HOROVOD_GRACE_SECONDS": "-3"}) == 0.0
+    assert preempt.grace_seconds({"HOROVOD_GRACE_SECONDS": "nope"}) == \
+        preempt.DEFAULT_GRACE_SECONDS
+
+
+def test_configured_requires_an_explicit_opt_in():
+    assert not preempt.configured({})
+    assert preempt.configured({"HOROVOD_GRACE_SECONDS": "10"})
+    assert preempt.configured({"HOROVOD_PREEMPT_NOTICE_FILE": "/p"})
+    assert preempt.configured({"HOROVOD_PREEMPT_NOTICE_URL": "http://x"})
+
+
+# ---------------------------------------------------------------------------
+# the eviction path, unit-level (fake state / clock / exit)
+# ---------------------------------------------------------------------------
+
+class FakeState:
+    def __init__(self, error=None):
+        self.flush_timeouts = []
+        self._error = error
+
+    def flush(self, timeout=None):
+        self.flush_timeouts.append(timeout)
+        if self._error is not None:
+            raise self._error
+
+
+def _run_eviction(kind="sigterm", state="default", env=None, grace=5.0):
+    codes = []
+    handler = GracefulEvictionHandler(
+        state=FakeState() if state == "default" else state,
+        grace=grace, env=env if env is not None else {},
+        exit_fn=codes.append)
+    t = handler.trigger(kind)
+    assert t is not None
+    t.join(10.0)
+    assert handler.finished.is_set()
+    return handler, codes
+
+
+def test_eviction_commits_within_grace_and_exits_clean():
+    handler, codes = _run_eviction()
+    assert handler.last["kind"] == "sigterm"
+    assert handler.last["outcome"] == "committed"
+    assert handler._state.flush_timeouts and \
+        handler._state.flush_timeouts[0] <= 5.0
+    assert codes == [0]  # no elastic epoch in env -> plain clean exit
+
+
+def test_eviction_exit_code_is_rendezvous_under_a_driver():
+    _handler, codes = _run_eviction(env={"HOROVOD_ELASTIC_EPOCH": "2"})
+    assert codes == [EXIT_RENDEZVOUS]
+
+
+def test_eviction_timeout_and_error_outcomes():
+    handler, codes = _run_eviction(state=FakeState(error=TimeoutError()))
+    assert handler.last["outcome"] == "timeout"
+    assert codes == [0]  # a blown grace budget still exits clean
+
+    handler, _ = _run_eviction(state=FakeState(error=RuntimeError("disk")))
+    assert handler.last["outcome"] == "error"
+
+    handler, _ = _run_eviction(state=None)
+    assert handler.last["outcome"] == "no-state"
+
+
+def test_eviction_is_idempotent():
+    codes = []
+    handler = GracefulEvictionHandler(state=FakeState(), grace=1.0, env={},
+                                      exit_fn=codes.append)
+    first = handler.trigger("sigterm")
+    assert handler.trigger("sigterm") is None  # second notice: no-op
+    first.join(10.0)
+    assert codes == [0]
+    assert len(handler._state.flush_timeouts) == 1
+
+
+def test_eviction_announces_doomed_host_on_kv():
+    kv = KVStoreServer()
+    port = kv.start()
+    try:
+        env = {"HOROVOD_HOSTNAME": "spot-a", "HOROVOD_RANK": "1",
+               "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+               "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port)}
+        handler, _codes = _run_eviction(env=env)
+        assert handler.last["announced"]
+        raw = kv.get(DOOMED_KEY_PREFIX + "spot-a")
+        assert raw is not None
+        info = json.loads(raw)
+        assert info["host"] == "spot-a" and info["kind"] == "sigterm"
+        assert info["rank"] == 1 and info["time"] > 0
+        marker = json.loads(kv.get(DOOMED_MARKER_KEY))
+        assert marker["host"] == "spot-a"
+    finally:
+        kv.stop()
+
+
+def test_teardown_fanout_suppresses_second_announcement():
+    """A SIGTERM right after ANOTHER host's doomed announcement is the
+    launcher recycling the epoch, not a second preemption: the rank must
+    still grace-commit and exit clean, but NOT announce its own host."""
+    kv = KVStoreServer()
+    port = kv.start()
+    try:
+        kv.put(DOOMED_MARKER_KEY, json.dumps(
+            {"host": "spot-b", "time": time.time()}).encode())
+        env = {"HOROVOD_HOSTNAME": "spot-a",
+               "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+               "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+               "HOROVOD_ELASTIC_EPOCH": "3"}
+        handler, codes = _run_eviction(env=env)
+        assert handler.last["kind"] == "teardown"
+        assert not handler.last["announced"]
+        assert handler.last["outcome"] == "committed"  # commit still runs
+        assert kv.get(DOOMED_KEY_PREFIX + "spot-a") is None
+        assert codes == [EXIT_RENDEZVOUS]
+    finally:
+        kv.stop()
+
+
+def test_stale_marker_from_other_host_does_not_suppress():
+    kv = KVStoreServer()
+    port = kv.start()
+    try:
+        kv.put(DOOMED_MARKER_KEY, json.dumps(
+            {"host": "spot-b",
+             "time": time.time() - 2 * preempt.TEARDOWN_WINDOW_S}).encode())
+        env = {"HOROVOD_HOSTNAME": "spot-a",
+               "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+               "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port)}
+        handler, _ = _run_eviction(env=env)
+        assert handler.last["kind"] == "sigterm"
+        assert handler.last["announced"]
+    finally:
+        kv.stop()
+
+
+def test_notice_file_polling_triggers_eviction(tmp_path):
+    """The cloud spot-notice shape: a file appearing at the configured
+    path starts the eviction from the poller thread."""
+    notice = tmp_path / "preempted"
+    codes = []
+    handler = GracefulEvictionHandler(
+        state=FakeState(), grace=2.0, notice_file=str(notice),
+        poll_interval=0.02, env={}, exit_fn=codes.append)
+    handler.install()
+    try:
+        time.sleep(0.1)
+        assert not handler.finished.is_set()  # no notice yet
+        notice.write_text("TRUE")
+        assert handler.finished.wait(10.0)
+        assert handler.last["kind"] == "notice:file"
+        assert codes == [0]
+    finally:
+        handler.uninstall()
+
+
+def test_install_idempotent_and_module_singleton():
+    codes = []
+    try:
+        h1 = preempt.install(state=FakeState(), grace=1.0, env={},
+                             exit_fn=codes.append)
+        h2 = preempt.install()
+        assert h1 is h2 is preempt.get_handler()
+        fresh = FakeState()
+        preempt.attach_state(fresh)
+        assert h1._state is fresh
+    finally:
+        preempt.uninstall()
+    assert preempt.get_handler() is None
+
+
+# ---------------------------------------------------------------------------
+# blacklist: drained != crashed, decay on sustained health
+# ---------------------------------------------------------------------------
+
+def test_blacklist_drain_carries_no_penalty():
+    now = {"t": 0.0}
+    bl = Blacklist(threshold=3, base_delay=10.0, clock=lambda: now["t"])
+    bl.record_drain("h")
+    bl.record_drain("h")
+    assert bl.drains("h") == 2
+    assert bl.count("h") == 0
+    assert not bl.excluded("h")  # the crash path would back off here
+    bl.record_failure("h")
+    assert bl.excluded("h")  # ...like this
+
+
+def test_blacklist_decay_forgives_failures_on_sustained_health():
+    now = {"t": 0.0}
+    bl = Blacklist(threshold=3, base_delay=1.0, clock=lambda: now["t"],
+                   decay_window=100.0)
+    bl.record_failure("h")
+    bl.record_failure("h")
+    assert bl.count("h") == 2
+
+    bl.observe_health({"h"})           # streak starts at t=0
+    now["t"] = 99.0
+    bl.observe_health({"h"})
+    assert bl.count("h") == 2          # window not yet full
+    now["t"] = 100.0
+    bl.observe_health({"h"})
+    assert bl.count("h") == 1          # one failure forgiven
+    now["t"] = 200.0
+    bl.observe_health({"h"})
+    assert bl.count("h") == 0          # fully forgiven
+    assert not bl.excluded("h")
+
+
+def test_blacklist_health_streak_broken_by_absence_or_failure():
+    now = {"t": 0.0}
+    bl = Blacklist(threshold=3, base_delay=1.0, clock=lambda: now["t"],
+                   decay_window=100.0)
+    bl.record_failure("h")
+    bl.observe_health({"h"})
+    now["t"] = 90.0
+    bl.observe_health(set())           # absent: streak lost
+    now["t"] = 110.0
+    bl.observe_health({"h"})           # streak restarts at t=110
+    assert bl.count("h") == 1
+    now["t"] = 209.0
+    bl.observe_health({"h"})
+    assert bl.count("h") == 1          # 99s < window
+    now["t"] = 215.0
+    bl.observe_health({"h"})
+    assert bl.count("h") == 0
+
+    # a new failure breaks the streak too
+    bl.record_failure("h")
+    bl.observe_health({"h"})           # anchor at 215
+    now["t"] = 250.0
+    bl.record_failure("h")             # streak gone
+    now["t"] = 320.0
+    bl.observe_health({"h"})           # restarts at 320
+    now["t"] = 400.0
+    bl.observe_health({"h"})
+    assert bl.count("h") == 2          # 80s < window: nothing forgiven
+
+
+def test_blacklist_permanent_exclusion_never_decays():
+    now = {"t": 0.0}
+    bl = Blacklist(threshold=2, base_delay=1.0, clock=lambda: now["t"],
+                   decay_window=10.0)
+    bl.record_failure("h")
+    bl.record_failure("h")
+    assert bl.blacklisted("h")
+    for t in (100.0, 1000.0, 1e6):
+        now["t"] = t
+        bl.observe_health({"h"})
+    assert bl.blacklisted("h") and bl.count("h") == 2
+
+
+def test_blacklist_decay_disabled_without_window():
+    now = {"t": 0.0}
+    bl = Blacklist(threshold=3, base_delay=1.0, clock=lambda: now["t"])
+    bl.record_failure("h")
+    now["t"] = 1e6
+    bl.observe_health({"h"})
+    assert bl.count("h") == 1  # observe_health is a no-op
+
+
+# ---------------------------------------------------------------------------
+# the driver's doomed-host plane
+# ---------------------------------------------------------------------------
+
+def _put_doomed(kv, host, kind="sigterm", ts=None):
+    payload = json.dumps({"host": host, "rank": 0, "kind": kind,
+                          "time": time.time() if ts is None else ts,
+                          "grace": 5.0}).encode()
+    kv.put(DOOMED_KEY_PREFIX + host, payload)
+    kv.put(DOOMED_MARKER_KEY, payload)
+
+
+def test_rendezvous_drains_announced_doomed_host():
+    kv = KVStoreServer()
+    kv.start()
+    try:
+        driver = ElasticDriver(FixedHosts({"hostA": 1, "hostB": 1}),
+                               min_np=1, kv=kv, poll_interval=0.05)
+        _put_doomed(kv, "hostA")
+        slots = driver.rendezvous()
+        assert {s.hostname for s in slots} == {"hostB"}
+        # one-shot: the announcement is consumed, not re-applied
+        assert kv.get(DOOMED_KEY_PREFIX + "hostA") is None
+        assert kv.get(DOOMED_MARKER_KEY) is None
+        slots = driver.rendezvous()
+        assert "hostA" in {s.hostname for s in slots}
+        driver.stop()
+    finally:
+        kv.stop()
+
+
+def test_rendezvous_reuses_doomed_host_below_min_np():
+    kv = KVStoreServer()
+    kv.start()
+    try:
+        driver = ElasticDriver(FixedHosts({"hostA": 1}), min_np=1, kv=kv,
+                               poll_interval=0.05)
+        _put_doomed(kv, "hostA")
+        slots = driver.rendezvous()
+        # losing the host would end the job: knowingly reused instead
+        assert {s.hostname for s in slots} == {"hostA"}
+        assert kv.get(DOOMED_KEY_PREFIX + "hostA") is None  # still consumed
+        driver.stop()
+    finally:
+        kv.stop()
+
+
+def test_stale_doomed_announcement_is_dropped():
+    kv = KVStoreServer()
+    kv.start()
+    try:
+        driver = ElasticDriver(FixedHosts({"hostA": 1, "hostB": 1}),
+                               min_np=1, kv=kv, poll_interval=0.05)
+        _put_doomed(kv, "hostA", ts=time.time() - DOOMED_TTL_S - 60)
+        slots = driver.rendezvous()
+        # a reclaimed host that came back must not stay excluded on a
+        # leftover key — and the stale key is garbage-collected
+        assert "hostA" in {s.hostname for s in slots}
+        assert kv.get(DOOMED_KEY_PREFIX + "hostA") is None
+        driver.stop()
+    finally:
+        kv.stop()
+
+
+class FakeJob:
+    def __init__(self, rcs):
+        self.rcs = rcs
+        self.first_failure = next(
+            ((r, c) for r, c in sorted(rcs.items()) if c != 0), None)
+
+    def join(self):
+        return dict(self.rcs)
+
+
+def test_run_job_drain_blame_on_graceful_eviction():
+    """EXIT_RENDEZVOUS backed by a doomed announcement is planned churn:
+    record_drain (no backoff), then the job finishes on the reused
+    capacity."""
+    kv = KVStoreServer()
+    kv.start()
+    try:
+        driver = ElasticDriver(FixedHosts({"hostA": 1}), min_np=1, kv=kv,
+                               poll_interval=0.05)
+
+        def launch(slots, epoch, env):
+            assert env["HOROVOD_ELASTIC"] == "1"
+            if epoch == 1:
+                _put_doomed(kv, "hostA")  # the worker announced, then...
+                return FakeJob({0: EXIT_RENDEZVOUS})  # ...drained
+            return FakeJob({0: 0})
+
+        epochs = driver.run_job(launch, max_epochs=4)
+    finally:
+        kv.stop()
+    assert epochs == 2
+    assert driver.blacklist.drains("hostA") == 1
+    assert driver.blacklist.count("hostA") == 0
+    assert not driver.blacklist.excluded("hostA")
+
+
+def test_run_job_drain_blame_when_sigkill_beats_the_grace_window():
+    """The host died mid-eviction (crash exit code, but its doom was
+    announced): still planned churn — drain accounting, no backoff."""
+    kv = KVStoreServer()
+    kv.start()
+    try:
+        driver = ElasticDriver(FixedHosts({"hostA": 1}), min_np=1, kv=kv,
+                               poll_interval=0.05)
+
+        def launch(slots, epoch, env):
+            if epoch == 1:
+                _put_doomed(kv, "hostA")
+                return FakeJob({0: -9})  # SIGKILL won the race
+            return FakeJob({0: 0})
+
+        epochs = driver.run_job(launch, max_epochs=4)
+    finally:
+        kv.stop()
+    assert epochs == 2
+    assert driver.blacklist.drains("hostA") == 1
+    assert driver.blacklist.count("hostA") == 0
+
+
+def test_run_job_crash_without_announcement_still_blames():
+    kv = KVStoreServer()
+    kv.start()
+    try:
+        driver = ElasticDriver(
+            FixedHosts({"hostA": 1}), min_np=1, kv=kv, poll_interval=0.05,
+            blacklist=Blacklist(threshold=3, base_delay=0.0))
+
+        def launch(slots, epoch, env):
+            return FakeJob({0: 1} if epoch == 1 else {0: 0})
+
+        epochs = driver.run_job(launch, max_epochs=4)
+    finally:
+        kv.stop()
+    assert epochs == 2
+    assert driver.blacklist.count("hostA") == 1
+    assert driver.blacklist.drains("hostA") == 0
+
+
+# ---------------------------------------------------------------------------
+# integration: drained recovery vs SIGKILL recovery
+# ---------------------------------------------------------------------------
+
+def _spawn_launch_fn(kv_port, worker_args, die_mode):
+    def launch(slots, epoch, elastic_env):
+        job = launcher.Job()
+        for slot in slots:
+            env = dict(os.environ)
+            env.update({
+                "HOROVOD_RANK": str(slot.rank),
+                "HOROVOD_SIZE": str(slot.size),
+                "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+                "HOROVOD_HOSTNAME": slot.hostname,
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(kv_port),
+                "HVD_ELASTIC_TEST_DIE": die_mode,
+                "HOROVOD_GRACE_SECONDS": "10",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": launcher.repo_pythonpath(),
+            })
+            env.update(elastic_env)
+            job.procs.append(subprocess.Popen(
+                [sys.executable, WORKER] + [str(a) for a in worker_args],
+                env=env))
+        return job
+
+    return launch
+
+
+@pytest.mark.slow
+def test_drained_recovery_cheaper_than_sigkill(tmp_path):
+    """ISSUE 15 acceptance: the same mid-training death, once as a
+    graceful eviction (SIGTERM -> announce -> commit -> exit 75) and
+    once as a hard SIGKILL. The drained run must recover without blame
+    or backoff — measurably cheaper wall-clock than the crash run,
+    whose host sits out the backoff window first."""
+    results = {}
+    for mode in ("evict", "kill"):
+        ckpt = tmp_path / mode / "ckpt"
+        log = tmp_path / mode / "losses.jsonl"
+        log.parent.mkdir(parents=True)
+        kv = KVStoreServer()
+        kv_port = kv.start()
+        try:
+            driver = ElasticDriver(
+                FixedHosts({"hostA": 1}), min_np=1, kv=kv,
+                poll_interval=0.1,
+                blacklist=Blacklist(threshold=3, base_delay=4.0))
+            launch = _spawn_launch_fn(kv_port, [ckpt, log, 6, "hostA", 2],
+                                      die_mode=mode)
+            t0 = time.monotonic()
+            epochs = driver.run_job(launch, max_epochs=4)
+            wall = time.monotonic() - t0
+        finally:
+            kv.stop()
+        assert epochs == 2
+        with open(log) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        done = [r for r in records if "done" in r]
+        assert done and done[0]["done"] == 6
+        assert done[0]["resumed_from"] >= 1
+        results[mode] = (wall, driver.blacklist)
+
+    wall_evict, bl_evict = results["evict"]
+    wall_kill, bl_kill = results["kill"]
+    # blame split: the eviction drained, the SIGKILL got charged
+    assert bl_evict.drains("hostA") == 1 and bl_evict.count("hostA") == 0
+    assert bl_kill.count("hostA") == 1 and bl_kill.drains("hostA") == 0
+    # and the drain is cheaper: no backoff window before re-rendezvous
+    assert wall_evict < wall_kill, (
+        f"drained recovery ({wall_evict:.1f}s) should beat the SIGKILL "
+        f"path ({wall_kill:.1f}s, which pays the 4s backoff)")
