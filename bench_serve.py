@@ -21,6 +21,20 @@ bench.py's goodput invariant — `SERVE ATTRIBUTION VIOLATED` printed
 loudly when it doesn't; tolerance mirrors
 telemetry/report.UNATTRIBUTED_TOLERANCE).
 
+Fleet extensions (ISSUE 16): ``--shared-prefix L`` prepends one fixed
+L-token system prompt to every request and reports the
+**cached-prefill fraction** (prompt tokens skipped via
+``kvcache.PrefixCache`` block reuse / all prompt tokens); ``--fleet N``
+drives N engine replicas behind a ``serve/fleet`` router (client-side
+TTFT through the router, per-replica attribution windows that end at a
+replica's eviction time); ``--chaos-at F`` delivers a preemption
+notice to replica r0 after fraction F of the arrival schedule — the
+run FAILS on any dropped request; ``--acceptance`` runs the ISSUE-16
+gate end to end (single-replica saturation probe → 2-replica fleet at
+2x that load → chaos soak) and exits nonzero unless cached-prefill
+fraction > 0.5, zero requests dropped, and every attribution block
+explains wall clock within tolerance.
+
 Runs on the 8-device CPU mesh exactly like the rest of the bench suite
 (`JAX_PLATFORMS=cpu python bench_serve.py`); the numbers are CPU-mesh
 numbers — the harness, shapes and invariants are what transfer to TPU.
@@ -52,6 +66,20 @@ def build_parser():
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help="shared system-prompt length prepended to every "
+                        "request (exercises the prefix cache)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="run N engine replicas behind the fleet router "
+                        "(0 = single inline engine)")
+    p.add_argument("--chaos-at", type=float, default=None,
+                   help="preempt replica r0 after this fraction of the "
+                        "arrival schedule (fleet mode)")
+    p.add_argument("--grace", type=float, default=0.5,
+                   help="preemption grace budget for --chaos-at, seconds")
+    p.add_argument("--acceptance", action="store_true",
+                   help="run the ISSUE-16 acceptance recipe (saturation "
+                        "probe -> 2-replica fleet at 2x -> chaos soak)")
     p.add_argument("--json", default=None,
                    help="also write the result block to this path")
     return p
@@ -64,14 +92,17 @@ def _percentiles_ms(samples, qs=(50, 99)):
     return {f"p{q}": round(float(np.percentile(arr, q)), 3) for q in qs}
 
 
-def run_bench(args):
+def _setup(args):
+    """Model, mesh, KV config and the request prompt list — shared by
+    the single-engine and fleet paths. The KV pool is sized for worst
+    case fully-fresh slots PLUS the shared prefix the cache retains."""
     import jax
     import jax.numpy as jnp
 
     from horovod_tpu.models.transformer import (Transformer,
                                                 TransformerConfig)
     from horovod_tpu.parallel import mesh as mesh_lib
-    from horovod_tpu.serve import KVCacheConfig, Request, ServeEngine
+    from horovod_tpu.serve import KVCacheConfig
 
     rng = np.random.default_rng(args.seed)
     cfg = TransformerConfig(
@@ -82,26 +113,44 @@ def run_bench(args):
     init_toks = jnp.zeros((1, 8), jnp.int32)
     params = model.init(jax.random.PRNGKey(args.seed), init_toks)["params"]
 
-    prompt_lens = rng.integers(max(1, args.prompt_len // 2),
-                               args.prompt_len * 3 // 2 + 1,
-                               args.requests)
-    max_seq = int(prompt_lens.max()) + args.max_new
+    prefix = list(map(int, rng.integers(0, args.vocab_size,
+                                        args.shared_prefix)))
+    tail_lens = rng.integers(max(1, args.prompt_len // 2),
+                             args.prompt_len * 3 // 2 + 1,
+                             args.requests)
+    prompts = [prefix + list(map(int, rng.integers(0, args.vocab_size,
+                                                   int(n))))
+               for n in tail_lens]
+
+    max_seq = max(len(p) for p in prompts) + args.max_new
     mbps = -(-max_seq // args.block_size)
+    prefix_blocks = args.shared_prefix // args.block_size
     kv = KVCacheConfig(
-        num_blocks=args.max_slots * mbps + 1, block_size=args.block_size,
+        num_blocks=args.max_slots * mbps + prefix_blocks + 1,
+        block_size=args.block_size,
         num_layers=args.num_layers, num_heads=args.num_heads,
         head_dim=args.d_model // args.num_heads,
         max_blocks_per_seq=mbps, dtype=jnp.float32)
     mesh = mesh_lib.build_mesh(jax.devices())
     n_chips = int(np.prod(mesh.devices.shape))
+    return rng, model, params, kv, mesh, n_chips, prompts
+
+
+def _cached_fraction(engines):
+    prompt = sum(e.prompt_tokens for e in engines)
+    cached = sum(e.cached_prefill_tokens for e in engines)
+    return (cached / prompt) if prompt else 0.0
+
+
+def run_bench(args):
+    from horovod_tpu.serve import Request, ServeEngine
+
+    rng, model, params, kv, mesh, n_chips, prompts = _setup(args)
     engine = ServeEngine(model, params, kv, mesh=mesh,
                          max_slots=args.max_slots,
                          prefill_chunk=args.prefill_chunk)
 
-    requests = [Request(list(map(int, rng.integers(0, args.vocab_size,
-                                                   int(n)))),
-                        args.max_new)
-                for n in prompt_lens]
+    requests = [Request(p, args.max_new) for p in prompts]
 
     # warm both compiled programs OUTSIDE the measured window (compile
     # time is a startup cost, not a serving latency; bench.py does the
@@ -112,6 +161,8 @@ def run_bench(args):
         engine.step()
     for k in engine.time_breakdown:
         engine.time_breakdown[k] = 0.0
+    engine.prompt_tokens = 0
+    engine.cached_prefill_tokens = 0
 
     # open loop: arrival i at t0 + i/rate, submitted when its time comes
     # whether or not the engine kept up
@@ -159,7 +210,9 @@ def run_bench(args):
         "requests": args.requests,
         "rate_rps": args.rate,
         "max_new_tokens": args.max_new,
-        "prompt_len_mean": float(np.mean(prompt_lens)),
+        "prompt_len_mean": round(float(np.mean([len(p)
+                                                for p in prompts])), 1),
+        "shared_prefix": args.shared_prefix,
         "max_slots": args.max_slots,
         "prefill_chunk": args.prefill_chunk,
         "kv_block_size": args.block_size,
@@ -171,25 +224,220 @@ def run_bench(args):
         "tokens_per_sec": round(total_tokens / wall_s, 2),
         "tokens_per_sec_per_chip": round(total_tokens / wall_s / n_chips,
                                          3),
+        "cached_prefill_fraction": round(_cached_fraction([engine]), 4),
         "attribution": attribution,
     }
     return result
 
 
+def run_fleet_bench(args):
+    """N replicas behind the fleet router, open-loop arrivals through
+    the frontend path (router.generate), optional mid-run chaos
+    preemption of r0. Attribution is per replica over its LIVE window
+    (start -> its eviction or the end of the run), summed fleet-wide;
+    any failed request fails the bench — the eviction path must drop
+    nothing."""
+    import jax
+
+    from horovod_tpu.parallel import mesh as mesh_lib
+    from horovod_tpu.serve import ServeEngine
+    from horovod_tpu.serve.fleet import FleetRouter
+
+    rng, model, params, kv, mesh, n_chips, prompts = _setup(args)
+    # each replica owns a DISJOINT submesh — the fleet topology is one
+    # replica per slice, and two engines dispatching concurrent SPMD
+    # programs over the SAME devices can deadlock their collectives
+    devs = jax.devices()
+    per = len(devs) // args.fleet
+    if per >= 1:
+        meshes = [mesh_lib.build_mesh(devs[i * per:(i + 1) * per])
+                  for i in range(args.fleet)]
+    else:  # fewer devices than replicas: single-device replicas
+        meshes = [mesh_lib.build_mesh([devs[i % len(devs)]])
+                  for i in range(args.fleet)]
+    engines = [ServeEngine(model, params, kv, mesh=meshes[i],
+                           max_slots=args.max_slots,
+                           prefill_chunk=args.prefill_chunk,
+                           name=f"r{i}")
+               for i in range(args.fleet)]
+    router = FleetRouter(grace=args.grace)
+    for i, eng in enumerate(engines):
+        router.add_replica(f"r{i}", eng, env={})
+    router.start()
+
+    # warm each replica's two programs outside the measured window
+    for eng in engines:
+        warm = eng.generate(list(map(int, rng.integers(
+            0, args.vocab_size, 3))), 2)
+        warm.result(timeout=300)
+    for eng in engines:
+        eng.prompt_tokens = 0
+        eng.cached_prefill_tokens = 0
+
+    chaos_index = (None if args.chaos_at is None
+                   else max(1, int(args.chaos_at * args.requests)))
+    chaos_thread = None
+    # attribution by snapshot delta (attribution_snapshot charges the
+    # in-progress idle tick exactly to each side of the boundary)
+    base_snap = {r.name: r.engine.attribution_snapshot()
+                 for r in router.replicas}
+    t0 = time.monotonic()
+    arrivals = [t0 + i / args.rate for i in range(args.requests)]
+    reqs = []
+    for i, (when, prompt) in enumerate(zip(arrivals, prompts)):
+        wait = when - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        if chaos_index is not None and i == chaos_index:
+            chaos_thread = router.preempt("r0", kind="notice:chaos")
+        reqs.append(router.generate(prompt, args.max_new))
+    while any(r.state not in ("done", "failed") for r in reqs):
+        time.sleep(0.005)
+    t_end = time.monotonic()
+    end_snap = {r.name: r.engine.attribution_snapshot()
+                for r in router.replicas}
+    wall_s = t_end - t0
+    if chaos_thread is not None:
+        chaos_thread.join(timeout=60)
+
+    failed = [r for r in reqs if r.state == "failed"]
+    if failed:
+        raise RuntimeError(f"{len(failed)} fleet request(s) DROPPED: "
+                           f"{failed[0].error}")
+
+    ttft = [r.first_token_time - r.arrival for r in reqs]
+    itl = [b - a for r in reqs
+           for a, b in zip(r.token_times, r.token_times[1:])]
+    total_tokens = sum(len(r.generated) for r in reqs)
+
+    # per-replica attribution: each engine thread accounts its own
+    # prefill/decode/overhead/idle; its window ends when it is evicted
+    per_replica, live_wall, attributed = {}, 0.0, 0.0
+    for rep in router.replicas:
+        window = (rep.stopped_at if rep.stopped_at is not None
+                  else t_end) - t0
+        phases = {k: end_snap[rep.name][k] - base_snap[rep.name][k]
+                  for k in end_snap[rep.name]}
+        explained = sum(phases.values())
+        live_wall += window
+        attributed += explained
+        per_replica[rep.name] = {
+            "state": rep.state,
+            "window_s": round(window, 4),
+            **{f"{k}_s": round(v, 4) for k, v in phases.items()},
+        }
+    unattributed = live_wall - attributed
+    attribution = {
+        "wall_s": round(wall_s, 4),
+        "replica_windows_s": round(live_wall, 4),
+        "attributed_s": round(attributed, 4),
+        "unattributed_fraction": round(unattributed / live_wall, 4),
+        "valid": abs(unattributed) <= ATTRIBUTION_TOLERANCE * live_wall,
+        "per_replica": per_replica,
+    }
+
+    result = {
+        "mode": "serve_fleet",
+        "devices": n_chips,
+        "replicas": args.fleet,
+        "requests": args.requests,
+        "rate_rps": args.rate,
+        "max_new_tokens": args.max_new,
+        "prompt_len_mean": round(float(np.mean([len(p)
+                                                for p in prompts])), 1),
+        "shared_prefix": args.shared_prefix,
+        "chaos_at": args.chaos_at,
+        "ttft_ms": _percentiles_ms(ttft),
+        "inter_token_ms": _percentiles_ms(itl),
+        "tokens_generated": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall_s, 2),
+        "cached_prefill_fraction": round(_cached_fraction(engines), 4),
+        "redispatched": router.redispatched,
+        "dropped": router.dropped,
+        "attribution": attribution,
+    }
+    router.stop()
+    return result
+
+
+def run_acceptance(args):
+    """The ISSUE-16 gate: (A) single-replica saturation probe on the
+    shared-prefix workload, (B) 2-replica fleet held at 2x that load
+    — the p99 TTFT the fleet sustains, (C) chaos soak — one replica
+    preempted mid-stream, zero drops, attribution still explaining
+    the replica windows."""
+    base = dict(vars(args))
+    base["shared_prefix"] = args.shared_prefix or 48
+
+    # (A) closed-system probe: everything arrives at once; measured
+    # throughput IS the single-replica saturation rate
+    probe = argparse.Namespace(**{**base, "rate": 10_000.0, "fleet": 0,
+                                  "chaos_at": None})
+    single = run_bench(probe)
+    sat_rps = single["tokens_per_sec"] / args.max_new
+
+    # (B) 2-replica fleet at 2x single-replica saturation
+    fleet_args = argparse.Namespace(**{**base, "fleet": 2,
+                                       "rate": 2.0 * sat_rps,
+                                       "chaos_at": None})
+    fleet = run_fleet_bench(fleet_args)
+
+    # (C) chaos soak: same fleet, moderate overload, r0 preempted
+    # mid-schedule — zero drops required (run_fleet_bench raises)
+    chaos_args = argparse.Namespace(**{**base, "fleet": 2,
+                                       "rate": 1.2 * sat_rps,
+                                       "chaos_at": 0.4})
+    chaos = run_fleet_bench(chaos_args)
+
+    checks = {
+        "cached_prefill_fraction_gt_half":
+            fleet["cached_prefill_fraction"] > 0.5,
+        "fleet_rate_ge_2x_saturation": fleet["rate_rps"] >= 2 * sat_rps,
+        "zero_dropped": chaos["dropped"] == 0,
+        "attribution_valid": (single["attribution"]["valid"]
+                              and fleet["attribution"]["valid"]
+                              and chaos["attribution"]["valid"]),
+    }
+    return {
+        "mode": "serve_fleet_acceptance",
+        "single_saturation_rps": round(sat_rps, 2),
+        "fleet_p99_ttft_ms": fleet["ttft_ms"]["p99"],
+        "chaos_redispatched": chaos["redispatched"],
+        "checks": checks,
+        "passed": all(checks.values()),
+        "single": single,
+        "fleet_2x": fleet,
+        "chaos_soak": chaos,
+    }
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    result = run_bench(args)
+    if args.acceptance:
+        result = run_acceptance(args)
+        ok = result["passed"]
+    elif args.fleet:
+        result = run_fleet_bench(args)
+        ok = result["attribution"]["valid"]
+    else:
+        result = run_bench(args)
+        ok = result["attribution"]["valid"]
     print(json.dumps(result, indent=1))
-    if not result["attribution"]["valid"]:
-        explained = 1 - abs(result["attribution"]["unattributed_fraction"])
-        print("SERVE ATTRIBUTION VIOLATED: engine phases + idle explain "
-              f"{explained:.1%} of wall clock (tolerance "
-              f"{ATTRIBUTION_TOLERANCE:.0%}) — a scheduler phase is "
-              "leaking unaccounted time")
+    if not ok:
+        if args.acceptance:
+            bad = [k for k, v in result["checks"].items() if not v]
+            print(f"SERVE FLEET ACCEPTANCE FAILED: {', '.join(bad)}")
+        else:
+            explained = 1 - abs(
+                result["attribution"]["unattributed_fraction"])
+            print("SERVE ATTRIBUTION VIOLATED: engine phases + idle "
+                  f"explain {explained:.1%} of wall clock (tolerance "
+                  f"{ATTRIBUTION_TOLERANCE:.0%}) — a scheduler phase is "
+                  "leaking unaccounted time")
     if args.json:
         with open(args.json, "w") as f:
             json.dump(result, f, indent=1)
-    return 0 if result["attribution"]["valid"] else 1
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -19,7 +19,16 @@ what already exists rather than duplicating it:
 * **frontend** (``server.py`` + ``cli.py``/``bin/hvd-serve``) — a
   streaming ``/generate`` endpoint on the shared stdlib HTTP
   scaffolding, ``/healthz`` + ``/metrics`` alongside, with the
-  ``hvd_serve_*`` instrument family in the standard registry.
+  ``hvd_serve_*`` instrument family in the standard registry;
+* **sampling** (``sampling.py``) — temperature / top-p with
+  per-request seeds, keyed on (seed, absolute position) so streams
+  are deterministic across replicas, batch composition, and
+  mid-flight continuation (greedy stays the default and the
+  ``temperature=0`` lane is bitwise the greedy argmax);
+* **fleet** (``fleet/``) — N engine replicas behind one routing
+  frontend: queue-depth/KV-headroom dispatch, rolling weight reload,
+  and spot-preemption drains that re-dispatch cut-off streams to a
+  survivor with zero dropped requests.
 
 ``bench_serve.py`` (repo root) is the load harness: p50/p99
 time-to-first-token, inter-token latency, tokens/sec/chip under an
@@ -32,9 +41,16 @@ from horovod_tpu.serve.engine import (  # noqa: F401
     RequestError,
     ServeEngine,
 )
+from horovod_tpu.serve.fleet import (  # noqa: F401
+    FleetRequest,
+    FleetRouter,
+    FleetServer,
+    Replica,
+)
 from horovod_tpu.serve.kvcache import (  # noqa: F401
     BlockAllocator,
     KVCacheConfig,
+    PrefixCache,
     init_pool,
 )
 from horovod_tpu.serve.loader import (  # noqa: F401
@@ -42,11 +58,16 @@ from horovod_tpu.serve.loader import (  # noqa: F401
     abstract_params,
     load_params,
 )
+from horovod_tpu.serve.sampling import (  # noqa: F401
+    GREEDY,
+    SamplingParams,
+)
 from horovod_tpu.serve.server import ServeServer  # noqa: F401
 
 __all__ = [
     "ServeEngine", "Request", "RequestError",
-    "KVCacheConfig", "BlockAllocator", "init_pool",
+    "KVCacheConfig", "BlockAllocator", "PrefixCache", "init_pool",
     "load_params", "abstract_params", "ReloadWatcher",
-    "ServeServer",
+    "ServeServer", "SamplingParams", "GREEDY",
+    "Replica", "FleetRouter", "FleetRequest", "FleetServer",
 ]

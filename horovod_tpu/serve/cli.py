@@ -20,6 +20,7 @@ mismatches fail loudly instead of serving garbage.
 
 import argparse
 import logging
+import os
 import signal
 import sys
 import threading
@@ -63,6 +64,17 @@ def build_parser():
     p.add_argument("--reload-poll-seconds", type=float, default=5.0)
     p.add_argument("--no-reload", action="store_true",
                    help="serve the startup checkpoint forever")
+    # fleet
+    p.add_argument("--fleet", type=int, default=1,
+                   help="number of engine replicas; > 1 splits the "
+                        "local devices into disjoint submeshes and "
+                        "serves them behind the fleet router "
+                        "(docs/SERVING.md, 'Serve fleet')")
+    p.add_argument("--grace", type=float, default=None,
+                   help="preemption drain budget per replica in "
+                        "seconds (default: HOROVOD_GRACE_SECONDS); "
+                        "notice sources come from the standard "
+                        "HOROVOD_PREEMPT_NOTICE_FILE/_URL env knobs")
     return p
 
 
@@ -93,11 +105,13 @@ def main(argv=None):
     import jax
     import jax.numpy as jnp
 
+    from horovod_tpu.elastic import preempt as preempt_lib
     from horovod_tpu.models.transformer import (Transformer,
                                                 TransformerConfig)
     from horovod_tpu.parallel import mesh as mesh_lib
     from horovod_tpu.serve import engine as engine_lib
     from horovod_tpu.serve import kvcache, loader
+    from horovod_tpu.serve.fleet import FleetRouter, FleetServer
     from horovod_tpu.serve.server import ServeServer
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
@@ -125,24 +139,57 @@ def main(argv=None):
     logger.info("hvd-serve: KV pool %d blocks x %d tokens (%.1f MiB)",
                 num_blocks, args.block_size, kv.pool_bytes() / 2 ** 20)
 
-    mesh = mesh_lib.build_mesh(jax.devices())
-    eng = engine_lib.ServeEngine(
-        model, params, kv, mesh=mesh, max_slots=args.max_slots,
-        prefill_chunk=args.prefill_chunk, weights_version=step)
-    eng.start()
+    devs = jax.devices()
+    router = None
+    if args.fleet > 1:
+        # One replica per disjoint device submesh: concurrent SPMD
+        # dispatch over shared devices can deadlock at collectives
+        # (docs/SERVING.md, "Serve fleet"). A host-wide spot notice
+        # drains every replica — the whole VM is doomed.
+        if args.fleet > len(devs):
+            raise SystemExit(
+                f"hvd-serve: --fleet {args.fleet} needs at least one "
+                f"device per replica ({len(devs)} available)")
+        per = len(devs) // args.fleet
+        notice_file = os.environ.get(preempt_lib.NOTICE_FILE_ENV)
+        notice_url = os.environ.get(preempt_lib.NOTICE_URL_ENV)
+        router = FleetRouter(grace=args.grace)
+        engines = []
+        for i in range(args.fleet):
+            sub = mesh_lib.build_mesh(devs[i * per:(i + 1) * per])
+            eng = engine_lib.ServeEngine(
+                model, params, kv, mesh=sub, max_slots=args.max_slots,
+                prefill_chunk=args.prefill_chunk, weights_version=step,
+                name=f"r{i}")
+            router.add_replica(f"r{i}", eng, notice_file=notice_file,
+                               notice_url=notice_url)
+            engines.append(eng)
+        router.start()
+        target_for_reload, frontend = router, FleetServer(
+            router, addr=args.addr, port=args.port)
+    else:
+        mesh = mesh_lib.build_mesh(devs)
+        eng = engine_lib.ServeEngine(
+            model, params, kv, mesh=mesh, max_slots=args.max_slots,
+            prefill_chunk=args.prefill_chunk, weights_version=step)
+        eng.start()
+        target_for_reload, frontend = eng, ServeServer(
+            eng, addr=args.addr, port=args.port)
 
     watcher = None
     if not args.no_reload:
-        watcher = loader.ReloadWatcher(args.ckpt_dir, eng, target,
+        watcher = loader.ReloadWatcher(args.ckpt_dir, target_for_reload,
+                                       target,
                                        poll_s=args.reload_poll_seconds)
         watcher.mark_current(step)
         watcher.start()
 
-    server = ServeServer(eng, addr=args.addr, port=args.port)
+    server = frontend
     server.start()  # a taken --port is fatal: let the OSError surface
     logger.info("hvd-serve: ready on http://%s:%d (weights step %d, "
-                "%d devices)", args.addr, server.port, step,
-                len(jax.devices()))
+                "%d devices, %d replica%s)", args.addr, server.port,
+                step, len(devs), args.fleet,
+                "" if args.fleet == 1 else "s")
 
     done = threading.Event()
 
@@ -157,7 +204,10 @@ def main(argv=None):
         server.stop()
         if watcher is not None:
             watcher.stop()
-        eng.stop()
+        if router is not None:
+            router.stop()  # stops every replica engine
+        else:
+            eng.stop()
     return 0
 
 

@@ -33,9 +33,22 @@ programs go through the PR-9 AOT machinery — one ``lower().compile()``
 per shape signature, compiled-HLO collective accounting under
 ``serve_*`` labels, executables called directly.
 
-Sampling is greedy (argmax) — deterministic, which is what makes
-"continuous-batched decode is bit-identical to a single-shot decode"
-a testable contract (tests/test_serve.py).
+Sampling defaults to greedy (argmax) — deterministic, which is what
+makes "continuous-batched decode is bit-identical to a single-shot
+decode" a testable contract (tests/test_serve.py). Real sampling
+(``serve/sampling.py``: temperature / top-p / per-request seeds) rides
+the SAME batched dispatch: per-slot seed/temperature/top-p arrays are
+runtime inputs of the compiled programs, per-slot RNG keys are folded
+from ``(seed, absolute token index)`` inside the program, and a slot
+at temperature 0 still takes the bitwise argmax lane.
+
+Prefix caching (``kvcache.PrefixCache``) short-circuits prefill:
+admission maps a prompt's already-cached full blocks straight into the
+sequence's block table (ref-counted shares; the one partially-reused
+block is copy-on-write forked) and prefill resumes at the first
+uncached token. A shared system prompt then costs one prefill total,
+not one per request — the cached-prefill fraction
+``bench_serve.py`` scores.
 """
 
 import itertools
@@ -53,6 +66,7 @@ from jax.sharding import PartitionSpec as P
 from horovod_tpu.parallel import gspmd as gspmd_lib
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.serve import kvcache
+from horovod_tpu.serve import sampling as sampling_lib
 from horovod_tpu.telemetry import instruments as instruments_lib
 
 logger = logging.getLogger("horovod_tpu")
@@ -75,12 +89,14 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, tokens, max_new_tokens, eos_id=None,
-                 request_id=None):
+                 request_id=None, sampling=None):
         self.id = (next(self._ids) if request_id is None
                    else request_id)
         self.prompt = [int(t) for t in tokens]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = None if eos_id is None else int(eos_id)
+        self.sampling = (sampling_lib.GREEDY if sampling is None
+                         else sampling)
         self.generated = []
         self.state = "new"  # new|queued|prefill|decode|done|failed
         self.finish_reason = None
@@ -88,6 +104,7 @@ class Request:
         self.slot = None
         self.blocks = None
         self.prefilled = 0  # prompt tokens whose KV is in the pool
+        self.cached_prompt_tokens = 0  # of those, served by prefix cache
         self.arrival = None
         self.first_token_time = None
         self.token_times = []
@@ -146,7 +163,8 @@ class ServeEngine:
 
     def __init__(self, model, params, kv_config, mesh=None, max_slots=4,
                  prefill_chunk=16, clock=time.monotonic, registry=None,
-                 weights_version=None):
+                 weights_version=None, prefix_caching=True,
+                 name="default"):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if prefill_chunk < 1:
@@ -154,6 +172,7 @@ class ServeEngine:
         self._model = model
         self._kv = kv_config
         self._clock = clock
+        self.name = str(name)
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         if mesh is None:
@@ -176,17 +195,29 @@ class ServeEngine:
             batch_spec = P()
         self._batch_sharding = self.plan.sharding(batch_spec)
 
-        self.instruments = instruments_lib.serve_instruments(registry)
+        self.instruments = instruments_lib.serve_instruments(
+            registry, replica=self.name)
         self.allocator = kvcache.BlockAllocator(kv_config.num_blocks)
+        self.prefix_cache = (
+            kvcache.PrefixCache(self.allocator, kv_config.block_size)
+            if prefix_caching else None)
+        # cumulative cached-prefill accounting (bench_serve.py's
+        # cached-prefill fraction = cached / prompt tokens)
+        self.prompt_tokens = 0
+        self.cached_prefill_tokens = 0
         # per-slot scheduler state (host): block table rows, cached-token
-        # counts, last sampled token — the mirror of what the device
-        # programs consume each iteration
+        # counts, last sampled token, sampling knobs — the mirror of
+        # what the device programs consume each iteration
         self._tables = np.zeros(
             (self.max_slots, kv_config.max_blocks_per_seq), np.int32)
         self._lengths = np.zeros((self.max_slots,), np.int32)
         self._last_token = np.zeros((self.max_slots,), np.int32)
+        self._seeds = np.zeros((self.max_slots,), np.uint32)
+        self._temps = np.zeros((self.max_slots,), np.float32)
+        self._top_ps = np.ones((self.max_slots,), np.float32)
         self._slots = [None] * self.max_slots
         self._waiting = deque()
+        self.draining = False  # refusing admission (drain / staging)
 
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
@@ -200,6 +231,7 @@ class ServeEngine:
         # against wall clock, goodput-ledger style)
         self.time_breakdown = {"prefill": 0.0, "decode": 0.0,
                                "overhead": 0.0, "idle": 0.0}
+        self._idle_since = None  # run-loop wait in progress since
 
         self._params = jax.device_put(params, self._rep)
         self._pool = jax.device_put(kvcache.init_pool(kv_config),
@@ -211,7 +243,8 @@ class ServeEngine:
         model, kv = self._model, self._kv
         max_context = kv.max_context
 
-        def decode_fn(params, pool, tokens, lengths, tables):
+        def decode_fn(params, pool, tokens, lengths, tables,
+                      seeds, temps, top_ps):
             # one new token per slot; slots with lengths == 0 are
             # inactive — their writes go to the null block and their
             # sampled token is ignored by the host
@@ -224,15 +257,21 @@ class ServeEngine:
                 kv_cache=(ctx_k, ctx_v, cpos))
             pool2 = kvcache.write_tokens(pool, tables, lengths, nk, nv,
                                          mask=active[:, None])
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            # the token being sampled sits at absolute index len+1 (the
+            # fed token occupies len) — the index the per-slot RNG key
+            # is folded from (serve/sampling.py)
+            nxt = sampling_lib.sample_tokens(
+                logits[:, -1, :], seeds, lengths + 1, temps, top_ps)
             return nxt, pool2
 
-        def prefill_fn(params, pool, tokens, start, total, table):
+        def prefill_fn(params, pool, tokens, start, total, table,
+                       seed, temp, top_p):
             # one chunk of one sequence: tokens [1, C] (pad past the
             # prompt), absolute positions start..start+C-1; context =
             # the sequence's own already-prefilled tokens. Returns the
-            # greedily sampled successor of the LAST PROMPT token —
-            # meaningful only on the final chunk (the host knows which).
+            # sampled successor of the LAST PROMPT token (absolute
+            # index ``total``) — meaningful only on the final chunk
+            # (the host knows which).
             c = tokens.shape[1]
             positions = (start + jnp.arange(c, dtype=jnp.int32))[None, :]
             valid = positions < total
@@ -248,7 +287,10 @@ class ServeEngine:
             last = jnp.clip(total - 1 - start, 0, c - 1)
             last_logits = jax.lax.dynamic_index_in_dim(
                 logits[0], last, axis=0, keepdims=False)
-            nxt = jnp.argmax(last_logits).astype(jnp.int32)
+            nxt = sampling_lib.sample_tokens(
+                last_logits[None, :], jnp.reshape(seed, (1,)),
+                jnp.reshape(total, (1,)), jnp.reshape(temp, (1,)),
+                jnp.reshape(top_p, (1,)))[0]
             return nxt, pool2
 
         rep, bsh = self._rep, self._batch_sharding
@@ -257,14 +299,21 @@ class ServeEngine:
         # buffered across every dispatch
         self._decode = _AotProgram(jax.jit(
             decode_fn,
-            in_shardings=(rep, rep, bsh, bsh, bsh),
+            in_shardings=(rep, rep, bsh, bsh, bsh, bsh, bsh, bsh),
             out_shardings=(rep, rep),
             donate_argnums=(1,)))
         self._prefill = _AotProgram(jax.jit(
             prefill_fn,
-            in_shardings=(rep, rep, rep, rep, rep, rep),
+            in_shardings=(rep, rep, rep, rep, rep, rep, rep, rep, rep),
             out_shardings=(rep, rep),
             donate_argnums=(1,)))
+        # the copy-on-write fork (prefix caching): src/dst are runtime
+        # scalars, so ONE compile covers every forked pair
+        self._fork = _AotProgram(jax.jit(
+            kvcache.copy_block,
+            in_shardings=(rep, rep, rep),
+            out_shardings=rep,
+            donate_argnums=(0,)))
 
     def _place_batch(self, x):
         return jax.device_put(np.asarray(x), self._batch_sharding)
@@ -284,6 +333,8 @@ class ServeEngine:
             err = None
             if self._stop.is_set() or self._broken is not None:
                 err = "serve engine is stopped"
+            elif self.draining:
+                err = "serve engine is draining"
             elif not request.prompt:
                 err = "empty prompt"
             elif request.max_new_tokens < 1:
@@ -309,9 +360,30 @@ class ServeEngine:
             self._work.notify_all()
         return request
 
-    def generate(self, tokens, max_new_tokens, eos_id=None):
+    def generate(self, tokens, max_new_tokens, eos_id=None,
+                 sampling=None):
         """Convenience: build + submit, returns the :class:`Request`."""
-        return self.submit(Request(tokens, max_new_tokens, eos_id=eos_id))
+        return self.submit(Request(tokens, max_new_tokens, eos_id=eos_id,
+                                   sampling=sampling))
+
+    @property
+    def kv_config(self):
+        return self._kv
+
+    def blocks_needed(self, prompt_len, max_new_tokens):
+        """KV blocks a request of this shape reserves at admission —
+        the router's headroom arithmetic (serve/fleet/router.py)."""
+        return self._kv.blocks_for(int(prompt_len) + int(max_new_tokens))
+
+    def set_draining(self, flag):
+        """Enter/leave the draining state: a draining engine refuses
+        NEW admissions (submit fails loudly, queued requests stay
+        queued) while in-flight sequences run to completion — the
+        preempt-drain and weight-staging window ``/healthz`` reports
+        as 503 ``draining`` (docs/SERVING.md, "Spot-drain runbook")."""
+        with self._work:
+            self.draining = bool(flag)
+            self._work.notify_all()
 
     # -- rolling weight reload ----------------------------------------------
     def install_weights(self, params, version=None):
@@ -344,22 +416,25 @@ class ServeEngine:
                 "serve engine is broken (a dispatch failed after the "
                 "pool was donated)") from self._broken
         t0 = self._clock()
-        with self._lock:
-            swapped = self._apply_staged_weights()
-            admitted = self._admit()
-            prefill_req = min(
-                (r for r in self._slots
-                 if r is not None and r.state == "prefill"),
-                key=lambda r: (r.arrival, r.id), default=None)
-            decoding = [i for i, r in enumerate(self._slots)
-                        if r is not None and r.state == "decode"]
         stats = {}
         compute_s = 0.0
-        if swapped:
-            stats["swapped"] = True
-        if admitted:
-            stats["admitted"] = len(admitted)
         try:
+            # admission is inside the failure boundary: the CoW fork it
+            # may dispatch donates the pool exactly like the two
+            # programs below
+            with self._lock:
+                swapped = self._apply_staged_weights()
+                admitted = self._admit()
+                prefill_req = min(
+                    (r for r in self._slots
+                     if r is not None and r.state == "prefill"),
+                    key=lambda r: (r.arrival, r.id), default=None)
+                decoding = [i for i, r in enumerate(self._slots)
+                            if r is not None and r.state == "decode"]
+            if swapped:
+                stats["swapped"] = True
+            if admitted:
+                stats["admitted"] = len(admitted)
             if prefill_req is not None:
                 t = self._clock()
                 self._prefill_step(prefill_req)
@@ -398,27 +473,67 @@ class ServeEngine:
 
     def _admit(self):
         admitted = []
-        while self._waiting:
+        while self._waiting and not self.draining:
             req = self._waiting[0]
             free = next((i for i, r in enumerate(self._slots)
                          if r is None), None)
             if free is None:
                 break
-            need = self._kv.blocks_for(len(req.prompt)
-                                       + req.max_new_tokens)
-            blocks = self.allocator.alloc(need)
+            total = self._kv.blocks_for(len(req.prompt)
+                                        + req.max_new_tokens)
+            # prefix-cache lookup: map already-cached full prompt
+            # blocks into this sequence's table instead of allocating
+            # + re-prefilling them
+            cached_len, shared = 0, []
+            if self.prefix_cache is not None:
+                cached_len, shared = self.prefix_cache.match(req.prompt)
+                # the final prompt token always prefills: its logits
+                # produce the first generated token
+                cached_len = min(cached_len, len(req.prompt) - 1)
+            # a shared block the sequence will WRITE INTO (the trailing
+            # block when the match is cut mid-block) must be forked —
+            # classic copy-on-write
+            cow = bool(shared) and \
+                cached_len < len(shared) * self._kv.block_size
+            n_fresh = total - len(shared) + (1 if cow else 0)
+            blocks = self.allocator.alloc(n_fresh)
+            if blocks is None and self.prefix_cache is not None:
+                # cache-held blocks are reclaimable memory: drop LRU
+                # entries until the reservation fits (live sequences'
+                # own refs keep their blocks safe)
+                self.prefix_cache.release(n_fresh)
+                blocks = self.allocator.alloc(n_fresh)
             if blocks is None:
                 break  # FIFO head backpressured on KV blocks
+            self.allocator.retain(shared)
+            if cow:
+                fork = blocks[0]
+                self._pool = self._fork(
+                    self._pool, self._place_rep(np.int32(shared[-1])),
+                    self._place_rep(np.int32(fork)))
+                self.allocator.free([shared[-1]])  # seq's ref only
+                seq_blocks = shared[:-1] + [fork] + blocks[1:]
+            else:
+                seq_blocks = shared + blocks
             self._waiting.popleft()
-            req.slot, req.blocks = free, blocks
+            req.slot, req.blocks = free, seq_blocks
             req.state = "prefill"
-            req.prefilled = 0
+            req.prefilled = cached_len
+            req.cached_prompt_tokens = cached_len
             self._slots[free] = req
             row = np.zeros((self._kv.max_blocks_per_seq,), np.int32)
-            row[:len(blocks)] = blocks
+            row[:len(seq_blocks)] = seq_blocks
             self._tables[free] = row
-            self._lengths[free] = 0
+            self._lengths[free] = cached_len
             self._last_token[free] = 0
+            sp = req.sampling
+            self._seeds[free] = np.uint32(int(sp.seed) & 0xFFFFFFFF)
+            self._temps[free] = np.float32(sp.temperature)
+            self._top_ps[free] = np.float32(sp.top_p)
+            self.prompt_tokens += len(req.prompt)
+            if cached_len:
+                self.cached_prefill_tokens += cached_len
+                self.instruments.cached_prefill_tokens.inc(cached_len)
             admitted.append(req)
         self.instruments.queue_depth.set(len(self._waiting))
         self.instruments.kv_blocks.set(self.allocator.in_use)
@@ -434,7 +549,10 @@ class ServeEngine:
             self._params, self._pool, self._place_rep(tokens),
             self._place_rep(np.int32(start)),
             self._place_rep(np.int32(len(req.prompt))),
-            self._place_rep(self._tables[req.slot:req.slot + 1]))
+            self._place_rep(self._tables[req.slot:req.slot + 1]),
+            self._place_rep(self._seeds[req.slot]),
+            self._place_rep(self._temps[req.slot]),
+            self._place_rep(self._top_ps[req.slot]))
         req.prefilled = min(start + c, len(req.prompt))
         self._lengths[req.slot] = req.prefilled
         if req.prefilled >= len(req.prompt):
@@ -443,6 +561,16 @@ class ServeEngine:
             tok = int(jax.device_get(nxt))
             req.state = "decode"
             self._last_token[req.slot] = tok
+            if self.prefix_cache is not None:
+                # every full prompt block is now immutable pool
+                # content — index it for later prompts
+                n_full = len(req.prompt) // self._kv.block_size
+                if n_full:
+                    with self._lock:
+                        self.prefix_cache.insert(
+                            req.prompt,
+                            [int(b) for b in
+                             self._tables[req.slot][:n_full]])
             self._append_token(req, tok, self._clock())
 
     def _decode_step(self, decoding):
@@ -453,7 +581,10 @@ class ServeEngine:
             self._params, self._pool,
             self._place_batch(self._last_token),
             self._place_batch(lengths),
-            self._place_batch(self._tables))
+            self._place_batch(self._tables),
+            self._place_batch(self._seeds),
+            self._place_batch(self._temps),
+            self._place_batch(self._top_ps))
         nxt = np.asarray(jax.device_get(nxt))
         now = self._clock()
         for i in decoding:
@@ -486,6 +617,9 @@ class ServeEngine:
             self._tables[req.slot] = 0
             self._lengths[req.slot] = 0
             self._last_token[req.slot] = 0
+            self._seeds[req.slot] = 0
+            self._temps[req.slot] = 0.0
+            self._top_ps[req.slot] = 1.0
             req.blocks = None
             req.state = "done"
             req.finish_reason = reason
@@ -515,13 +649,19 @@ class ServeEngine:
         if any(r is not None for r in self._slots):
             return True
         # a waiting request counts as work only if admission could
-        # succeed — a backpressured head must not busy-spin
-        if self._waiting:
+        # succeed — a backpressured head must not busy-spin (a draining
+        # engine admits nothing, so its queue is not work either)
+        if self._waiting and not self.draining:
             req = self._waiting[0]
             need = self._kv.blocks_for(len(req.prompt)
                                        + req.max_new_tokens)
+            # cache-held blocks count as reclaimable headroom: when no
+            # sequence is live (the case that reaches this arithmetic)
+            # every cache entry holds the sole reference to its block
+            reclaimable = (self.prefix_cache.size
+                           if self.prefix_cache is not None else 0)
             return (any(r is None for r in self._slots)
-                    and need <= self.allocator.available)
+                    and need <= self.allocator.available + reclaimable)
         return False
 
     def _loop(self):
@@ -532,8 +672,21 @@ class ServeEngine:
                     if self._stop.is_set() or self._has_work_locked():
                         continue
                     t = self._clock()
+                    self._idle_since = t
                     self._work.wait(timeout=0.05)
+                    self._idle_since = None
                     self.note_idle(self._clock() - t)
+
+    def attribution_snapshot(self):
+        """``time_breakdown`` including the run loop's in-progress idle
+        wait, exact as of now — so a measurement window boundary (a
+        bench's, a fleet's per-replica window) doesn't mis-charge the
+        wait tick it lands inside."""
+        snap = dict(self.time_breakdown)
+        since = self._idle_since
+        if since is not None:
+            snap["idle"] += max(0.0, self._clock() - since)
+        return snap
 
     def start(self):
         """Run the scheduler on a background thread (the HTTP

@@ -1,0 +1,39 @@
+"""The serve fleet: N engine replicas behind one routing frontend.
+
+One :class:`~horovod_tpu.serve.engine.ServeEngine` answers requests;
+this package is what turns a set of them into a SERVICE (ROADMAP
+north star, "heavy traffic"):
+
+* **replica** (``replica.py``) — one named engine plus its lifecycle
+  state (``ready`` / ``draining`` / ``dead``) and, on spot capacity,
+  its armed preemption handler — the ``elastic/preempt.py`` machinery
+  (notice polling, grace budget, announce, ``hvd_preemptions_total``)
+  pointed at traffic drain instead of checkpoint commit;
+* **router** (``router.py``) — queue-depth- and KV-headroom-aware
+  dispatch over the ready replicas, fleet-wide rolling weight reload
+  (one replica staged at a time, so the fleet never has zero admitting
+  replicas), and the zero-drop eviction path: a request cut off by a
+  dying replica is re-dispatched to a survivor as a CONTINUATION
+  (``prompt + tokens generated so far``), which the position-keyed
+  sampling of ``serve/sampling.py`` makes stream-transparent — the
+  client sees one uninterrupted, seed-deterministic token stream;
+* **frontend** (``frontend.py``) — the one streaming HTTP endpoint in
+  front of the fleet, same wire protocol as the single-replica
+  ``serve/server.py`` plus fleet-shaped ``/healthz``.
+
+Replicas here are in-process (each engine already owns its mesh
+placement, pool, and scheduler thread); the router/replica split is
+what a multi-host deployment would put a network between, and
+everything the router consumes (health state, queue depth, KV
+headroom, weights version) is exactly what the per-replica
+``/healthz`` already reports. docs/SERVING.md, "Serve fleet".
+"""
+
+from horovod_tpu.serve.fleet.frontend import FleetServer  # noqa: F401
+from horovod_tpu.serve.fleet.replica import Replica  # noqa: F401
+from horovod_tpu.serve.fleet.router import (  # noqa: F401
+    FleetRequest,
+    FleetRouter,
+)
+
+__all__ = ["Replica", "FleetRouter", "FleetRequest", "FleetServer"]

@@ -21,13 +21,25 @@ writes are routed into it, and inactive batch slots' tables point at it
 absolute-position causal mask in ``models/transformer.py`` zeroes it
 exactly).
 
+**Prefix caching** (vLLM-style automatic prompt caching) rides the
+same substrate: blocks are REF-COUNTED (:class:`BlockAllocator` keeps
+a count per block, not a set), a :class:`PrefixCache` indexes full
+prompt blocks by a chained content hash, and a new sequence whose
+prompt starts with an already-cached block chain maps those pool
+blocks into its own table instead of re-prefilling them. Shared
+blocks are read-only by construction — every token position inside
+them is already written and never rewritten; the one partial block a
+prefix match can touch is forked first (:func:`copy_block`, classic
+copy-on-write) so the writer gets a private copy.
+
 Everything device-side here is a pure function over arrays —
 ``serve/engine.py`` composes them inside its jitted prefill/decode
-programs; only :class:`BlockAllocator` is host state.
+programs; only :class:`BlockAllocator` and :class:`PrefixCache` are
+host state.
 """
 
 import dataclasses
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any
 
 import jax.numpy as jnp
@@ -133,18 +145,24 @@ def write_tokens(pool, block_table, start, new_k, new_v, mask=None):
 
 
 class BlockAllocator:
-    """Host-side free list over pool blocks ``1..num_blocks-1``.
+    """Host-side REF-COUNTED free list over pool blocks
+    ``1..num_blocks-1``.
 
     ``alloc`` is all-or-nothing — a request that cannot get its full
     reservation gets ``None`` and stays queued (the engine's KV
-    backpressure); ``free`` returns an eviction's blocks to the pool.
-    Not thread-safe by itself: the engine mutates it only under its
-    scheduler lock."""
+    backpressure) — and hands out blocks at refcount 1. Prefix sharing
+    adds holders via :meth:`retain`; ``free`` drops one reference per
+    listed block and returns it to the pool only when the LAST holder
+    lets go. Freeing (or retaining) a block that is not allocated
+    raises loudly instead of silently corrupting the free list —
+    under refcounting a quiet double free would hand the same block to
+    two live sequences and cross their caches. Not thread-safe by
+    itself: the engine mutates it only under its scheduler lock."""
 
     def __init__(self, num_blocks):
         self.capacity = int(num_blocks) - 1
         self._free = deque(range(1, int(num_blocks)))
-        self._out = set()
+        self._refs = {}  # block id -> reference count (> 0)
 
     @property
     def available(self):
@@ -152,7 +170,7 @@ class BlockAllocator:
 
     @property
     def in_use(self):
-        return len(self._out)
+        return len(self._refs)
 
     def alloc(self, n):
         if n < 0:
@@ -160,13 +178,142 @@ class BlockAllocator:
         if n > len(self._free):
             return None
         blocks = [self._free.popleft() for _ in range(n)]
-        self._out.update(blocks)
+        for b in blocks:
+            self._refs[b] = 1
         return blocks
 
-    def free(self, blocks):
+    def retain(self, blocks):
+        """Add one reference per listed block (a new sequence mapping
+        shared prefix blocks, or the prefix cache indexing them)."""
         for b in blocks:
-            if b not in self._out:
+            if b not in self._refs:
                 raise ValueError(
-                    f"double free of KV block {b} (allocated: no)")
-            self._out.discard(b)
-            self._free.append(b)
+                    f"retain of KV block {b} (allocated: no)")
+        for b in blocks:
+            self._refs[b] += 1
+
+    def free(self, blocks):
+        """Drop one reference per listed block. Validates the WHOLE
+        list first — a bad free raises before any block moves, so the
+        free list is never half-updated."""
+        dropping = {}
+        for b in blocks:
+            if self._refs.get(b, 0) - dropping.get(b, 0) <= 0:
+                raise ValueError(
+                    f"double free of KV block {b} (allocated: "
+                    f"{'yes' if b in self._refs else 'no'})")
+            dropping[b] = dropping.get(b, 0) + 1
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
+
+    def ref_count(self, block):
+        """Current reference count (0 = not allocated)."""
+        return self._refs.get(block, 0)
+
+    def is_shared(self, block):
+        """True when more than one holder maps this block (a writer
+        must copy-on-write before touching it)."""
+        return self._refs.get(block, 0) > 1
+
+
+def copy_block(pool, src, dst):
+    """Device-side block copy — the copy-on-write fork. ``src``/``dst``
+    are int32 scalars (traced inside the engine's jitted admission
+    program: one compile covers every (src, dst) pair). The forked
+    writer then owns ``dst`` outright; ``src`` stays shared and
+    read-only."""
+    return {"k": pool["k"].at[:, dst].set(pool["k"][:, src]),
+            "v": pool["v"].at[:, dst].set(pool["v"][:, src])}
+
+
+class PrefixCache:
+    """Content-addressed index of FULL prompt blocks for prefix reuse.
+
+    Keying is vLLM's chained block hash: block ``i`` of a prompt is
+    keyed by ``hash((key_{i-1}, tokens[i*bs:(i+1)*bs]))`` — the key
+    commits to the whole prefix through this block, so two prompts
+    share a cache entry iff they are token-identical up to and
+    including it. Only full blocks are indexed (a partial block is
+    still being written; full prompt blocks are never rewritten), and
+    :meth:`insert` happens after the block's prefill chunk completed,
+    so every indexed block is immutable pool content.
+
+    The cache holds its OWN reference on each indexed block — a block
+    can outlive the sequence that prefilled it and seed later requests
+    (that is the whole point of a system-prompt cache). Memory
+    pressure flows the other way through :meth:`release`: when the
+    allocator cannot cover an admission, least-recently-matched
+    entries are dropped until it can (live sequences' own references
+    keep their blocks safe — only the cache's claim is released).
+
+    Host state, engine-lock discipline, like the allocator."""
+
+    def __init__(self, allocator, block_size, capacity_blocks=None):
+        self._alloc = allocator
+        self._bs = int(block_size)
+        self._cap = capacity_blocks
+        self._entries = OrderedDict()  # chain key -> block id
+        self.hit_tokens = 0   # prompt tokens served from cache
+        self.miss_tokens = 0  # prompt tokens that had to prefill
+
+    @property
+    def size(self):
+        return len(self._entries)
+
+    def _keys(self, tokens):
+        key, out = None, []
+        for i in range(len(tokens) // self._bs):
+            key = hash((key, tuple(tokens[i * self._bs:
+                                          (i + 1) * self._bs])))
+            out.append(key)
+        return out
+
+    def match(self, tokens):
+        """Longest indexed full-block chain prefixing ``tokens`` →
+        ``(cached_token_count, [block ids])``. Takes NO references —
+        the caller retains before the engine lock is released."""
+        blocks = []
+        for key in self._keys(tokens):
+            block = self._entries.get(key)
+            if block is None:
+                break
+            self._entries.move_to_end(key)  # LRU touch
+            blocks.append(block)
+        return len(blocks) * self._bs, blocks
+
+    def insert(self, tokens, table_blocks):
+        """Index a freshly prefilled prompt's full blocks
+        (``table_blocks`` = the sequence's block-table prefix). Chains
+        already present keep their existing block (first writer wins —
+        identical content by construction); new tails take a cache
+        reference on the sequence's own block."""
+        keys = self._keys(tokens)
+        for key, block in zip(keys, table_blocks):
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            self._alloc.retain([block])
+            self._entries[key] = block
+        while self._cap is not None and len(self._entries) > self._cap:
+            self._evict_lru()
+
+    def _evict_lru(self):
+        key, block = next(iter(self._entries.items()))
+        del self._entries[key]
+        self._alloc.free([block])
+
+    def release(self, need):
+        """Drop LRU entries until the allocator can cover ``need``
+        blocks (or the cache is empty). Returns entries dropped."""
+        dropped = 0
+        while self._alloc.available < need and self._entries:
+            self._evict_lru()
+            dropped += 1
+        return dropped
+
+    def clear(self):
+        while self._entries:
+            self._evict_lru()

@@ -1,0 +1,96 @@
+"""Real sampling for the serving plane: temperature / top-p / seeds.
+
+Greedy argmax stays the default (deterministic — the property the
+engine's "continuous batching is bit-identical to single-shot" test
+contract is built on). This module adds stochastic decoding WITHOUT
+giving that determinism up:
+
+* **Per-request seeds.** Every sampled token's randomness comes from
+  ``fold_in(PRNGKey(seed), token_index)`` where ``token_index`` is the
+  token's ABSOLUTE position in the sequence (prompt tokens count). The
+  key depends only on (seed, position) — not on batch composition, not
+  on which replica runs the request, not on how the prompt was chunked
+  — so the same seed + prompt reproduces the same stream on any
+  replica, across a mid-flight weight reload of the same params, and
+  even when a fleet router re-dispatches a half-finished request to
+  another replica with ``prompt + generated-so-far`` as the new prompt
+  (the continuation's first sampled token sits at the same absolute
+  index, hence draws the same key).
+* **One batched dispatch.** :func:`sample_tokens` is pure and
+  batch-shaped: the engine threads per-slot ``seeds``/``temperature``/
+  ``top_p`` arrays through its ONE compiled decode program; per-slot
+  keys are derived inside the program. No per-request dispatches, no
+  recompiles (the knobs are runtime arrays, not static constants).
+* **Bitwise-greedy at temperature 0.** ``temperature <= 0`` selects
+  the plain ``argmax`` lane — not a limit of a softmax, the identical
+  integer — so deterministic requests keep matching the greedy oracle
+  bit-for-bit while sharing the batch with sampled ones.
+
+Top-p (nucleus) filtering keeps the smallest logit-ranked set whose
+probability mass reaches ``top_p`` (always at least the top token),
+then draws via Gumbel-max over the surviving logits — one argmax, no
+host-side categorical draw.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs. The default is greedy decoding
+    (``temperature=0``), matching the engine's deterministic
+    contract; ``seed`` only matters once ``temperature > 0``."""
+
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if not 0 < self.top_p <= 1:
+            raise ValueError("top_p must be in (0, 1]")
+        int(self.seed)  # must be integral
+
+
+GREEDY = SamplingParams()
+
+
+def sample_tokens(logits, seeds, indices, temperature, top_p):
+    """Batched per-slot next-token selection: ``[B, V]`` logits →
+    ``[B]`` int32 token ids.
+
+    ``seeds``/``indices``/``temperature``/``top_p`` are ``[B]``
+    arrays; ``indices[i]`` is the ABSOLUTE index of the token being
+    sampled for slot ``i`` (len(prompt) + generated so far) — the
+    fold-in that makes streams position-deterministic (module
+    docstring). Slots with ``temperature <= 0`` take the bitwise
+    argmax lane."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # the zero-temperature lane's scaled logits are discarded by the
+    # final where; guard the division so they are merely unused, not NaN
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits.astype(jnp.float32) / safe_t[:, None].astype(
+        jnp.float32)
+    # nucleus cutoff in sorted space: keep while the mass BEFORE a
+    # token is < top_p (the top token's "before" mass is 0 — always in)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = mass_before < top_p[:, None].astype(jnp.float32)
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    nucleus = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+
+    def draw(seed, index, row):
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed.astype(jnp.uint32)),
+            index.astype(jnp.uint32))
+        gumbel = jax.random.gumbel(key, row.shape, jnp.float32)
+        return jnp.argmax(row + gumbel).astype(jnp.int32)
+
+    sampled = jax.vmap(draw)(seeds, indices, nucleus)
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -4,7 +4,9 @@ Built on the same ``utils/httpd`` scaffolding as the telemetry plane.
 Endpoints:
 
 * ``POST /generate`` — body ``{"tokens": [int, ...],
-  "max_new_tokens": N, "eos_id": optional}``. The response streams
+  "max_new_tokens": N, "eos_id": optional, "temperature": optional,
+  "top_p": optional, "seed": optional}`` (the sampling knobs of
+  ``serve/sampling.py``; omitted = greedy). The response streams
   newline-delimited JSON (``application/x-ndjson``): one
   ``{"token": t}`` line per generated token **as the engine produces
   it** (HTTP/1.0, connection-close delimited — no chunked-encoding
@@ -15,7 +17,11 @@ Endpoints:
 * ``GET /healthz`` — serving liveness: queue depth, active sequences,
   KV-pool occupancy, installed weights version. Follows the telemetry
   plane's convention (200 ok / 503 when the engine is down) so the
-  same probes drive both.
+  same probes drive both — and mirrors its elastic-transition shape
+  with a third state: a replica refusing admission (preempt-drain or
+  weight staging) answers 503 with ``status: "draining"``, which is
+  what tells a fleet router (serve/fleet/) to dispatch elsewhere while
+  in-flight streams finish.
 * ``GET /metrics`` — the shared registry in Prometheus text format
   (the ``hvd_serve_*`` family plus everything else this process
   records), for deployments that don't also run the telemetry server.
@@ -29,6 +35,7 @@ import json
 import logging
 
 from horovod_tpu.serve.engine import Request, RequestError
+from horovod_tpu.serve.sampling import SamplingParams
 from horovod_tpu.telemetry.registry import get_registry
 from horovod_tpu.utils.httpd import HttpService, QuietHandler
 
@@ -68,15 +75,23 @@ class ServeServer(HttpService):
                         eng = server.engine
                         down = (eng._stop.is_set()
                                 or eng._broken is not None)
+                        draining = (not down
+                                    and getattr(eng, "draining", False))
+                        status = ("down" if down
+                                  else "draining" if draining else "ok")
                         body = {
-                            "status": "down" if down else "ok",
+                            "status": status,
                             "queue_depth": eng.queue_depth,
                             "active": eng.active_count,
                             "kv_blocks_in_use": eng.allocator.in_use,
                             "kv_blocks_free": eng.allocator.available,
                             "weights_version": eng.weights_version,
                         }
-                        self._respond_json(503 if down else 200, body)
+                        # draining is 503 like down: probes pull the
+                        # replica from rotation while it finishes
+                        # in-flight work (admission is refused anyway)
+                        self._respond_json(200 if status == "ok" else 503,
+                                           body)
                     elif self.path == "/metrics":
                         self._respond(
                             200, server.registry.render_prometheus(),
@@ -112,13 +127,23 @@ class ServeServer(HttpService):
                                            for t in tokens)):
                             raise ValueError(
                                 "tokens must be a list of ints")
-                        # Request() coerces max_new_tokens/eos_id — a
-                        # non-numeric field is a CLIENT error, so it
-                        # must be built inside this block to 400, not
-                        # fall through to the generic 500 handler
+                        # Request()/SamplingParams() coerce and
+                        # validate their fields — a non-numeric field
+                        # is a CLIENT error, so both must be built
+                        # inside this block to 400, not fall through
+                        # to the generic 500 handler
+                        sp = None
+                        if any(k in body for k in ("temperature",
+                                                   "top_p", "seed")):
+                            sp = SamplingParams(
+                                temperature=float(
+                                    body.get("temperature", 0.0)),
+                                top_p=float(body.get("top_p", 1.0)),
+                                seed=int(body.get("seed", 0)))
                         req = Request(tokens,
                                       int(body.get("max_new_tokens", 16)),
-                                      eos_id=body.get("eos_id"))
+                                      eos_id=body.get("eos_id"),
+                                      sampling=sp)
                     except (KeyError, ValueError, TypeError) as e:
                         return self._respond_json(400, {"error": str(e)})
                     try:

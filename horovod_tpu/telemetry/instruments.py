@@ -77,6 +77,8 @@ SERVE_QUEUE_DEPTH = "hvd_serve_queue_depth"
 SERVE_KV_BLOCKS = "hvd_serve_kv_blocks_in_use"
 SERVE_TTFT_SECONDS = "hvd_serve_ttft_seconds"
 SERVE_INTER_TOKEN_SECONDS = "hvd_serve_inter_token_seconds"
+SERVE_CACHED_PREFILL_TOKENS = "hvd_serve_cached_prefill_tokens_total"
+SERVE_REPLICAS = "hvd_serve_replicas"
 # -- goodput ledger (telemetry/ledger.py, docs/OBSERVABILITY.md) ------------
 TIME_SECONDS = "hvd_time_seconds_total"
 GOODPUT_RATIO = "hvd_goodput_ratio"
@@ -128,6 +130,7 @@ CATALOGUE = (
     DATA_BYTES_STAGED, DATA_BATCHES,
     SERVE_REQUESTS, SERVE_TOKENS, SERVE_QUEUE_DEPTH, SERVE_KV_BLOCKS,
     SERVE_TTFT_SECONDS, SERVE_INTER_TOKEN_SECONDS,
+    SERVE_CACHED_PREFILL_TOKENS, SERVE_REPLICAS,
     TIME_SECONDS, GOODPUT_RATIO, BUILD_INFO,
 )
 
@@ -463,14 +466,22 @@ class ServeInstruments:
     """The inference server's request-level instruments
     (docs/SERVING.md, docs/OBSERVABILITY.md "Serving plane"): request
     lifecycle counts by event, generated-token throughput, scheduler
-    queue depth, paged-KV pool occupancy, and the two latencies a
-    serving SLO is written against — time-to-first-token (arrival →
-    first streamed token: queueing + prefill) and inter-token latency
-    (the steady-state decode cadence)."""
+    queue depth, paged-KV pool occupancy, prefix-cache hits, and the
+    two latencies a serving SLO is written against —
+    time-to-first-token (arrival → first streamed token: queueing +
+    prefill) and inter-token latency (the steady-state decode
+    cadence).
 
-    def __init__(self, registry=None):
+    ``replica`` labels the per-engine GAUGES (queue depth, KV
+    occupancy): a fleet's replicas share one registry, and unlabeled
+    gauges would clobber each other on every scheduler tick. Counters
+    and histograms stay fleet-wide families (monotonic sums aggregate
+    correctly)."""
+
+    def __init__(self, registry=None, replica="default"):
         r = registry if registry is not None else get_registry()
         self.registry = r
+        self.replica = str(replica)
         self._requests = r.counter(
             SERVE_REQUESTS,
             "Generate requests by lifecycle event (submitted / "
@@ -480,13 +491,19 @@ class ServeInstruments:
         self.failed = self._requests.labels("failed")
         self.tokens = r.counter(
             SERVE_TOKENS, "Tokens generated and streamed to clients")
+        self.cached_prefill_tokens = r.counter(
+            SERVE_CACHED_PREFILL_TOKENS,
+            "Prompt tokens whose prefill was skipped via prefix-cache "
+            "block reuse (kvcache.PrefixCache)")
         self.queue_depth = r.gauge(
             SERVE_QUEUE_DEPTH,
             "Requests admitted-pending (queued behind KV blocks or "
-            "batch slots)")
+            "batch slots), per engine replica",
+            label_names=("replica",)).labels(self.replica)
         self.kv_blocks = r.gauge(
             SERVE_KV_BLOCKS, "Paged-KV pool blocks currently allocated "
-            "to live sequences")
+            "to live sequences, per engine replica",
+            label_names=("replica",)).labels(self.replica)
         self.ttft_seconds = r.histogram(
             SERVE_TTFT_SECONDS,
             "Time to first token: request arrival -> first streamed "
@@ -499,8 +516,18 @@ class ServeInstruments:
                      1.0, 2.5))
 
 
-def serve_instruments(registry=None):
-    return ServeInstruments(registry)
+def serve_instruments(registry=None, replica="default"):
+    return ServeInstruments(registry, replica=replica)
+
+
+def serve_replicas_gauge(registry=None):
+    """The one declaration of ``hvd_serve_replicas`` — fleet replica
+    counts by state (``ready`` / ``draining`` / ``dead``), recorded by
+    the fleet router (serve/fleet/router.py)."""
+    r = registry if registry is not None else get_registry()
+    return r.gauge(SERVE_REPLICAS,
+                   "Serve-fleet replicas by state (ready / draining / "
+                   "dead)", label_names=("state",))
 
 
 def build_info_labels(config=None):

@@ -58,6 +58,17 @@ def _oracle(model, params, prompt, n):
     return out[len(prompt):]
 
 
+def _assert_no_leak(eng):
+    """Every allocated block is either gone or held ONLY by the prefix
+    cache; clearing the cache must return the pool to empty."""
+    cached = eng.prefix_cache.size if eng.prefix_cache is not None else 0
+    assert eng.allocator.in_use == cached
+    if eng.prefix_cache is not None:
+        eng.prefix_cache.clear()
+    assert eng.allocator.in_use == 0
+    assert eng.allocator.available == eng.allocator.capacity
+
+
 def _run_until(eng, reqs, max_steps=500):
     for _ in range(max_steps):
         if all(r.state in ("done", "failed") for r in reqs):
@@ -186,7 +197,7 @@ def test_engine_matches_single_shot_oracle_with_midflight_joins():
         assert r.generated == _oracle(model, params, p, 8)
         assert r.result(timeout=5) == r.generated  # stream sees the same
         assert r.finish_reason == "length"
-    assert eng.allocator.in_use == 0
+    _assert_no_leak(eng)
 
 
 def test_engine_sharded_decode_batch_matches_oracle(hvd, n_devices):
@@ -218,7 +229,7 @@ def test_engine_eos_stops_early():
     r = eng.generate(p, 50, eos_id=first)  # first sampled token IS eos
     _run_until(eng, [r])
     assert r.generated == [first] and r.finish_reason == "eos"
-    assert eng.allocator.in_use == 0
+    _assert_no_leak(eng)
 
 
 # ---------------------------------------------------------------------------
@@ -334,8 +345,9 @@ def test_kv_exhaustion_backpressure_then_eviction_readmits():
         assert r2.state == "queued"  # backpressured the whole time
     _run_until(eng, [r2])
     assert r2.generated == _oracle(model, params, r2.prompt, 8)
-    assert eng.allocator.in_use == 0
-    assert eng.instruments.kv_blocks.value == 0
+    # the only blocks still held are the prefix cache's claim on the
+    # two finished prompts' full blocks
+    _assert_no_leak(eng)
 
 
 def test_submit_rejects_unsatisfiable_reservation():
@@ -672,6 +684,9 @@ def test_http_bad_requests_get_400(hvd):
         for body in (b"{}", b'{"tokens": "nope"}',
                      b'{"tokens": [1], "eos_id": "x"}',
                      b'{"tokens": [1], "max_new_tokens": "many"}',
+                     b'{"tokens": [1], "temperature": -0.5}',
+                     b'{"tokens": [1], "top_p": 0}',
+                     b'{"tokens": [1], "seed": "lucky"}',
                      json.dumps({"tokens": [1], "max_new_tokens":
                                  10 ** 6}).encode()):
             req = urllib.request.Request(
@@ -695,3 +710,250 @@ def test_cli_parser_and_meta_check():
     cli._check_meta({}, args)                                 # absent ok
     with pytest.raises(SystemExit, match="mismatched architecture"):
         cli._check_meta({"model_config": {"d_model": 512}}, args)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: ref-counted allocator, prefix caching / CoW, real sampling
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_free_unallocated_and_retain_validation():
+    a = kvcache.BlockAllocator(8)
+    with pytest.raises(ValueError, match="allocated: no"):
+        a.free([3])
+    with pytest.raises(ValueError, match="retain"):
+        a.retain([5])
+    b = a.alloc(2)
+    a.retain(b)                      # refs 2
+    a.free(b)                        # refs 1 — still allocated
+    assert a.in_use == 2 and all(a.ref_count(x) == 1 for x in b)
+    a.free(b)                        # refs 0 — returned to the pool
+    assert a.in_use == 0 and a.available == a.capacity
+    with pytest.raises(ValueError, match="double free"):
+        a.free(b)
+    # validate-first: a bad free is ATOMIC — nothing is half-freed
+    c = a.alloc(1)
+    with pytest.raises(ValueError, match="allocated: no"):
+        a.free(c + [99])
+    assert a.in_use == 1 and a.ref_count(c[0]) == 1
+    a.free(c)
+
+
+def test_allocator_invariant_fuzz():
+    """Randomized alloc/retain/free against a shadow refcount model:
+    conservation (available + in_use == capacity) and per-block
+    refcounts hold after every operation."""
+    from collections import Counter
+
+    rng = np.random.default_rng(123)
+    a = kvcache.BlockAllocator(33)
+    refs = Counter()
+    for _ in range(2000):
+        op = int(rng.integers(0, 3))
+        if op == 0:
+            n = int(rng.integers(1, 5))
+            got = a.alloc(n)
+            if got is None:
+                assert a.available < n    # refuses only when it must
+            else:
+                assert len(set(got)) == n
+                for b in got:
+                    assert refs[b] == 0   # never hands out a live block
+                    refs[b] += 1
+        elif op == 1 and refs:
+            b = int(rng.choice(list(refs.keys())))
+            a.retain([b])
+            refs[b] += 1
+        elif op == 2 and refs:
+            b = int(rng.choice(list(refs.keys())))
+            a.free([b])
+            refs[b] -= 1
+            if not refs[b]:
+                del refs[b]
+        assert a.in_use == len(refs)
+        assert a.available + a.in_use == a.capacity
+        for b, n in refs.items():
+            assert a.ref_count(b) == n
+    for b, n in list(refs.items()):
+        a.free([b] * n)                   # dups within one call are fine
+    assert a.in_use == 0 and a.available == a.capacity
+
+
+def test_prefix_cache_chain_match_insert_release():
+    a = kvcache.BlockAllocator(16)
+    pc = kvcache.PrefixCache(a, block_size=4)
+    toks = list(range(10))                # 2 full blocks + a partial
+    assert pc.match(toks) == (0, [])
+    blocks = a.alloc(3)
+    pc.insert(toks, blocks[:2])           # full blocks only, per contract
+    assert all(a.ref_count(b) == 2 for b in blocks[:2])  # cache holds refs
+    n, shared = pc.match(toks)
+    assert n == 8 and shared == blocks[:2]
+    n2, s2 = pc.match(toks[:7])           # shorter prompt: prefix chain
+    assert n2 == 4 and s2 == blocks[:1]
+    assert pc.match([99] + toks[1:]) == (0, [])   # diverging first block
+    # chained hashing: same 2nd-block CONTENT behind a different 1st
+    # block must not match (the chain key includes the predecessor)
+    other = [7] * 4 + toks[4:8]
+    assert pc.match(other) == (0, [])
+    # release-under-pressure evicts LRU entries until `need` fits
+    a.free(blocks)                        # drop our refs; cache keeps its 2
+    assert a.available == a.capacity - 2
+    pc.release(a.capacity)                # need everything -> evict all
+    assert pc.size == 0 and a.available == a.capacity
+    assert pc.match(toks) == (0, [])
+
+
+def test_engine_prefix_cache_hits_match_oracle():
+    """Requests sharing a system prompt skip cached prefill chunks and
+    still produce oracle-identical tokens; the cached-token accounting
+    and metric advance together."""
+    cfg, model, params = _model()
+    eng = ServeEngine(model, params, _kv(cfg), max_slots=4,
+                      prefill_chunk=4, registry=MetricsRegistry())
+    rng = np.random.default_rng(21)
+    system = list(map(int, rng.integers(0, 64, 9)))   # 2 full blocks + 1
+    r1 = eng.generate(system + [5], 6)
+    _run_until(eng, [r1])
+    assert r1.cached_prompt_tokens == 0               # first writer: miss
+    assert r1.generated == _oracle(model, params, r1.prompt, 6)
+    r2 = eng.generate(system + [7, 8], 6)
+    _run_until(eng, [r2])
+    assert r2.cached_prompt_tokens == 8               # both full blocks
+    assert r2.generated == _oracle(model, params, r2.prompt, 6)
+    assert eng.cached_prefill_tokens == 8
+    assert eng.instruments.cached_prefill_tokens.value == 8
+    assert eng.prompt_tokens == len(r1.prompt) + len(r2.prompt)
+    _assert_no_leak(eng)
+
+
+def test_engine_cow_fork_keeps_cached_blocks_immutable():
+    """Exact resubmission of a block-aligned prompt: the final prompt
+    token must re-prefill (its logits seed generation), which WRITES
+    into the last shared block — copy-on-write forks it so the cache's
+    copy stays pristine for the next hit."""
+    cfg, model, params = _model()
+    eng = ServeEngine(model, params, _kv(cfg), max_slots=4,
+                      prefill_chunk=4, registry=MetricsRegistry())
+    rng = np.random.default_rng(22)
+    p = list(map(int, rng.integers(0, 64, 8)))        # exactly 2 blocks
+    want = _oracle(model, params, p, 5)
+    r1 = eng.generate(p, 5)
+    _run_until(eng, [r1])
+    assert r1.generated == want
+    r2 = eng.generate(p, 5)                           # exact resubmit
+    _run_until(eng, [r2])
+    assert r2.cached_prompt_tokens == 7               # len(prompt) - 1
+    assert r2.generated == want
+    r3 = eng.generate(p, 5)                           # cache still intact
+    _run_until(eng, [r3])
+    assert r3.cached_prompt_tokens == 7 and r3.generated == want
+    _assert_no_leak(eng)
+
+
+def test_engine_prefix_cache_evicts_under_allocator_pressure():
+    """A full cache yields its blocks (LRU-first) when a new admission
+    cannot reserve fresh ones — correctness beats reuse."""
+    cfg, model, params = _model()
+    eng = ServeEngine(model, params,
+                      _kv(cfg, num_blocks=9, block_size=4, mbps=8),
+                      max_slots=1, prefill_chunk=4,
+                      registry=MetricsRegistry())
+    rng = np.random.default_rng(23)
+    p1 = list(map(int, rng.integers(0, 64, 8)))
+    r1 = eng.generate(p1, 4)                          # 3 blocks; caches 2
+    _run_until(eng, [r1])
+    assert eng.prefix_cache.size == 2
+    p2 = list(map(int, rng.integers(0, 64, 26)))      # needs 8 blocks
+    r2 = eng.generate(p2, 4)
+    _run_until(eng, [r2])
+    assert r2.generated == _oracle(model, params, p2, 4)
+    assert eng.prefix_cache.match(p1) == (0, [])      # LRU gave blocks up
+    assert eng.prefix_cache.match(p2)[0] > 0          # newest prompt cached
+    _assert_no_leak(eng)
+
+
+def test_sampling_temperature_zero_is_bitwise_greedy():
+    from horovod_tpu.serve.sampling import SamplingParams
+
+    cfg, model, params = _model()
+    eng = ServeEngine(model, params, _kv(cfg), max_slots=2,
+                      prefill_chunk=4, registry=MetricsRegistry())
+    rng = np.random.default_rng(31)
+    p = list(map(int, rng.integers(0, 64, 6)))
+    r = eng.generate(p, 10, sampling=SamplingParams(temperature=0.0,
+                                                    top_p=0.7, seed=99))
+    _run_until(eng, [r])
+    assert r.generated == _oracle(model, params, p, 10)
+
+
+def test_seeded_sampling_deterministic_across_replicas_and_reload():
+    """Same (seed, prompt) → identical stream on two independent
+    engines, across a mid-flight weight reload (same values, new
+    version), and across a continuation re-dispatch (prompt + already-
+    generated tokens, remaining budget) — the position-keyed RNG makes
+    the stream independent of WHERE and in how many hops it ran."""
+    from horovod_tpu.serve.sampling import SamplingParams
+
+    cfg, model, params = _model()
+    rng = np.random.default_rng(32)
+    p = list(map(int, rng.integers(0, 64, 6)))
+    sp = SamplingParams(temperature=0.9, top_p=0.8, seed=7)
+
+    def fresh():
+        return ServeEngine(model, params, _kv(cfg), max_slots=2,
+                           prefill_chunk=4, registry=MetricsRegistry())
+
+    e1, e2 = fresh(), fresh()
+    r1 = e1.generate(p, 12, sampling=sp)
+    _run_until(e1, [r1])
+    r2 = e2.generate(p, 12, sampling=sp)
+    _run_until(e2, [r2])
+    assert r1.generated == r2.generated           # replica-independent
+    r3 = e1.generate(p, 12, sampling=SamplingParams(temperature=0.9,
+                                                    top_p=0.8, seed=8))
+    _run_until(e1, [r3])
+    assert r3.generated != r1.generated           # the seed is live
+
+    e3 = fresh()
+    r4 = e3.generate(p, 12, sampling=sp)
+    while len(r4.generated) < 6:                  # mid-flight...
+        e3.step()
+    e3.install_weights(params, version=9)         # ...reload (same values)
+    _run_until(e3, [r4])
+    assert e3.weights_version == 9
+    assert r4.generated == r1.generated           # stream unchanged
+
+    k = 5                                          # continuation hop
+    r5 = e1.generate(p + r1.generated[:k], 12 - k, sampling=sp)
+    _run_until(e1, [r5])
+    assert r5.generated == r1.generated[k:]
+
+
+def test_healthz_draining_is_503_and_refuses_admission(hvd):
+    cfg, model, params = _model()
+    eng = ServeEngine(model, params, _kv(cfg), max_slots=1,
+                      prefill_chunk=4, registry=MetricsRegistry())
+    server = ServeServer(eng, port=0)
+    port = server.start()
+    eng.start()
+    try:
+        h = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert h["status"] == "ok"
+        eng.set_draining(True)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "draining"
+        with pytest.raises(RequestError, match="draining"):
+            eng.submit(Request([1, 2], 2))
+        eng.set_draining(False)
+        h = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert h["status"] == "ok"                # admission restored
+        assert eng.generate([1, 2], 2).result(timeout=60)
+    finally:
+        server.stop()
+        eng.stop()

@@ -1,0 +1,256 @@
+"""Serve fleet (ISSUE 16): replica router over N engines, queue-depth/
+KV-headroom dispatch, spot-preemption drain reusing elastic/preempt.py,
+zero-drop re-dispatch of cut-off streams, rolling fleet-wide weight
+reload, and the fleet HTTP frontend. The tier-1 e2e here is the chaos
+contract: a 2-replica fleet on disjoint CPU submeshes, concurrent
+streams, one replica evicted mid-stream — zero dropped requests and
+every stream token-identical to the single-shot oracle. See
+docs/SERVING.md ("Serve fleet")."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from test_serve import _kv, _model, _oracle
+
+from horovod_tpu.parallel import mesh as mesh_lib
+from horovod_tpu.serve.engine import RequestError, ServeEngine
+from horovod_tpu.serve.fleet import FleetRouter, FleetServer
+from horovod_tpu.telemetry import instruments as instruments_lib
+from horovod_tpu.telemetry.registry import MetricsRegistry
+
+
+def _fleet(model, params, cfg, reg, grace=5.0, max_slots=4,
+           notice_files=(None, None), **kv_kw):
+    """Two replicas on DISJOINT submeshes (a real fleet is one replica
+    per slice; concurrent SPMD dispatch over shared devices can
+    deadlock collectives), behind a started router."""
+    devs = jax.devices()
+    half = max(1, len(devs) // 2)
+    meshes = [mesh_lib.build_mesh(devs[:half]),
+              mesh_lib.build_mesh(devs[half:] or devs[:half])]
+    engines = [ServeEngine(model, params, _kv(cfg, **kv_kw),
+                           mesh=meshes[i], max_slots=max_slots,
+                           prefill_chunk=4, registry=reg, name=f"r{i}")
+               for i in range(2)]
+    router = FleetRouter(registry=reg, grace=grace)
+    for i, eng in enumerate(engines):
+        router.add_replica(f"r{i}", eng, env={},
+                           notice_file=notice_files[i],
+                           poll_interval=0.01)
+    router.start()
+    return router, engines
+
+
+def _gauge(reg, state):
+    return instruments_lib.serve_replicas_gauge(reg).labels(state).value
+
+
+def test_fleet_dispatch_skips_draining_replica_and_counts_states():
+    cfg, model, params = _model()
+    reg = MetricsRegistry()
+    router, engines = _fleet(model, params, cfg, reg)
+    try:
+        rng = np.random.default_rng(40)
+        assert _gauge(reg, "ready") == 2
+        router.drain_traffic("r0", grace=0.5)
+        assert engines[0].draining
+        assert _gauge(reg, "ready") == 1 and _gauge(reg, "draining") == 1
+        assert router.healthz()["status"] == "ok"     # r1 still admits
+        reqs = [router.generate(list(map(int, rng.integers(0, 64, 4))), 4)
+                for _ in range(2)]
+        for r in reqs:
+            assert r.result(timeout=120) == _oracle(model, params,
+                                                    r.prompt, 4)
+            assert r.replica == "r1"                  # never the drained
+        router.evict("r0")
+        assert _gauge(reg, "dead") == 1
+        h = router.healthz()
+        assert h["replicas"]["r0"]["state"] == "dead"
+        assert h["status"] == "ok" and h["ready_replicas"] == 1
+    finally:
+        router.stop()
+
+
+def test_fleet_e2e_chaos_eviction_mid_stream_zero_drop():
+    """The tier-1 chaos contract: concurrent streams across both
+    replicas, r0 killed mid-stream — every request finishes, the
+    re-dispatched continuations are token-identical to the oracle
+    (the position-keyed sampling makes the hop invisible), and the
+    drop counter stays at zero."""
+    cfg, model, params = _model()
+    reg = MetricsRegistry()
+    router, engines = _fleet(model, params, cfg, reg, num_blocks=128)
+    try:
+        rng = np.random.default_rng(41)
+        n_new = 24
+        reqs = [router.generate(list(map(int, rng.integers(0, 64, 5))),
+                                n_new)
+                for _ in range(5)]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(r.replica == "r0" and r.generated for r in reqs) \
+                    and any(r.replica == "r1" for r in reqs):
+                break
+            time.sleep(0.005)
+        assert any(r.replica == "r0" and len(r.generated) < n_new
+                   for r in reqs), "no stream in flight on the victim"
+        router.evict("r0")                            # chaos: no grace
+        outs = [r.result(timeout=120) for r in reqs]
+        assert router.dropped == 0
+        assert router.redispatched >= 1               # a stream WAS cut
+        assert all(len(o) == n_new for o in outs)
+        for r, o in zip(reqs, outs):
+            assert o == _oracle(model, params, r.prompt, n_new), \
+                f"{r.id} diverged after {r.hops} hop(s)"
+        # the fleet keeps serving on the survivor
+        extra = router.generate(list(map(int, rng.integers(0, 64, 4))), 4)
+        assert extra.result(timeout=120) == _oracle(model, params,
+                                                    extra.prompt, 4)
+    finally:
+        router.stop()
+
+
+def test_fleet_spot_notice_file_drains_gracefully(tmp_path):
+    """The spot-capacity path end to end: the per-replica preemption
+    handler (elastic/preempt.py machinery) polls a notice file; when
+    it appears, traffic drains off the doomed replica inside the grace
+    budget and the replica exits rotation — zero drops, no client ever
+    sees the eviction."""
+    cfg, model, params = _model()
+    reg = MetricsRegistry()
+    notice = tmp_path / "preempt-notice"
+    router, engines = _fleet(model, params, cfg, reg, grace=30.0,
+                             notice_files=(str(notice), None))
+    try:
+        rng = np.random.default_rng(42)
+        reqs = [router.generate(list(map(int, rng.integers(0, 64, 5))), 6)
+                for _ in range(4)]
+        notice.write_text("preempted\n")              # the spot notice
+        outs = [r.result(timeout=120) for r in reqs]
+        deadline = time.time() + 60
+        while router.replica("r0").state != "dead" \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert router.replica("r0").state == "dead"
+        assert router.dropped == 0
+        for r, o in zip(reqs, outs):
+            assert o == _oracle(model, params, r.prompt, 6)
+        assert router.healthz()["ready_replicas"] == 1
+    finally:
+        router.stop()
+
+
+def test_fleet_rolling_reload_never_closes_admission():
+    """install_weights stages one replica at a time: while the roll is
+    in progress the fleet never reports "down", requests keep being
+    admitted, and both replicas converge on the new version."""
+    cfg, model, params = _model()
+    reg = MetricsRegistry()
+    router, engines = _fleet(model, params, cfg, reg)
+    try:
+        rng = np.random.default_rng(43)
+        statuses, stop_probe = [], threading.Event()
+
+        def probe():
+            while not stop_probe.is_set():
+                statuses.append(router.healthz()["status"])
+                time.sleep(0.002)
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        background = [router.generate(
+            list(map(int, rng.integers(0, 64, 4))), 12)
+            for _ in range(3)]
+        router.install_weights(params, version=5)     # same values
+        during = router.generate(list(map(int, rng.integers(0, 64, 4))), 4)
+        stop_probe.set()
+        t.join(timeout=30)
+        assert router.weights_version == 5
+        assert all(e.weights_version == 5 for e in engines)
+        assert statuses and "down" not in statuses
+        for r in background + [during]:
+            assert r.result(timeout=120) == _oracle(
+                model, params, r.prompt, r.max_new_tokens)
+        assert router.dropped == 0
+    finally:
+        router.stop()
+
+
+def test_fleet_frontend_http_stream_health_and_all_dead(hvd):
+    cfg, model, params = _model()
+    reg = MetricsRegistry()
+    router, engines = _fleet(model, params, cfg, reg)
+    server = FleetServer(router, port=0)
+    port = server.start()
+    try:
+        rng = np.random.default_rng(44)
+        p = list(map(int, rng.integers(0, 64, 5)))
+        body = json.dumps({"tokens": p, "max_new_tokens": 6}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            lines = [json.loads(ln) for ln in resp]
+        assert lines[-1]["done"]
+        assert lines[-1]["tokens"] == _oracle(model, params, p, 6)
+        assert lines[-1]["hops"] == 0
+        toks = [ln["token"] for ln in lines[:-1]]
+        assert toks == lines[-1]["tokens"]            # streamed == final
+
+        # seeded sampling through the frontend is reproducible
+        sbody = json.dumps({"tokens": p, "max_new_tokens": 6,
+                            "temperature": 0.9, "top_p": 0.8,
+                            "seed": 11}).encode()
+        runs = []
+        for _ in range(2):
+            sreq = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=sbody,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(sreq, timeout=120) as resp:
+                runs.append(json.loads(list(resp)[-1])["tokens"])
+        assert runs[0] == runs[1]
+
+        h = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert h["status"] == "ok" and h["ready_replicas"] == 2
+        scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "hvd_serve_replicas" in scrape
+        assert "hvd_serve_cached_prefill_tokens_total" in scrape
+
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=b'{"tokens": [1], "temperature": -1}')
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=10)
+        assert e.value.code == 400
+
+        router.evict("r0")
+        router.evict("r1")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "down"
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            lines = [json.loads(ln) for ln in resp]
+        assert "no live replica" in lines[-1]["error"]
+    finally:
+        server.stop()
+        router.stop()
+
+
+def test_fleet_submit_after_stop_is_loud():
+    cfg, model, params = _model()
+    reg = MetricsRegistry()
+    router, _ = _fleet(model, params, cfg, reg)
+    router.stop()
+    with pytest.raises(RequestError, match="stopped"):
+        router.generate([1, 2, 3], 2)
