@@ -490,6 +490,13 @@ class ServeEngine:
                 # the final prompt token always prefills: its logits
                 # produce the first generated token
                 cached_len = min(cached_len, len(req.prompt) - 1)
+                # pin the match BEFORE any release(): an LRU eviction
+                # under pressure may drop a matched entry whose sole
+                # holder is the cache — unpinned, its block returns to
+                # the free list and the retry alloc can hand it back
+                # as a fresh WRITABLE block, duplicating it in this
+                # sequence's table (decode writes into cached prefix)
+                self.allocator.retain(shared)
             # a shared block the sequence will WRITE INTO (the trailing
             # block when the match is cut mid-block) must be forked —
             # classic copy-on-write
@@ -500,12 +507,13 @@ class ServeEngine:
             if blocks is None and self.prefix_cache is not None:
                 # cache-held blocks are reclaimable memory: drop LRU
                 # entries until the reservation fits (live sequences'
-                # own refs keep their blocks safe)
+                # refs — and the pin above — keep their blocks safe)
                 self.prefix_cache.release(n_fresh)
                 blocks = self.allocator.alloc(n_fresh)
             if blocks is None:
+                if shared:
+                    self.allocator.free(shared)  # drop the pin
                 break  # FIFO head backpressured on KV blocks
-            self.allocator.retain(shared)
             if cow:
                 fork = blocks[0]
                 self._pool = self._fork(
@@ -655,10 +663,10 @@ class ServeEngine:
             req = self._waiting[0]
             need = self._kv.blocks_for(len(req.prompt)
                                        + req.max_new_tokens)
-            # cache-held blocks count as reclaimable headroom: when no
-            # sequence is live (the case that reaches this arithmetic)
-            # every cache entry holds the sole reference to its block
-            reclaimable = (self.prefix_cache.size
+            # sole-reference cache entries count as reclaimable
+            # headroom (an entry a live sequence also maps frees no
+            # block when released)
+            reclaimable = (self.prefix_cache.reclaimable()
                            if self.prefix_cache is not None else 0)
             return (any(r is None for r in self._slots)
                     and need <= self.allocator.available + reclaimable)

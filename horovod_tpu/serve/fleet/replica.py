@@ -65,9 +65,12 @@ class Replica:
 
     def headroom_for(self, need_blocks):
         """True when the replica could cover a ``need_blocks`` KV
-        reservation: free blocks plus the prefix cache's reclaimable
-        claim (engine admission releases cache LRU under pressure)."""
-        reclaimable = (self.engine.prefix_cache.size
+        reservation: free blocks plus the prefix cache's RECLAIMABLE
+        claim (engine admission releases cache LRU under pressure).
+        Only sole-reference cache entries count — an entry a live
+        sequence also maps frees no pool block when released, so
+        counting it would score headroom the replica doesn't have."""
+        reclaimable = (self.engine.prefix_cache.reclaimable()
                        if self.engine.prefix_cache is not None else 0)
         return (self.engine.allocator.available + reclaimable
                 >= need_blocks)

@@ -82,17 +82,20 @@ class FleetRequest:
         self.error = None
         self.replica = None     # replica currently (last) running it
         self.hops = 0           # re-dispatches survived
-        # client-observable latency (wall clock: what a caller on the
-        # other side of the frontend would measure — TTFT spans router
-        # queueing, dispatch, engine queueing AND any re-dispatch)
+        # client-observable latency (what a caller on the other side
+        # of the frontend would measure — TTFT spans router queueing,
+        # dispatch, engine queueing AND any re-dispatch). Stamped with
+        # the ROUTER's clock (installed at submit) so fake-clock tests
+        # and benches see one time base fleet-wide.
         self.arrival = None
         self.first_token_time = None
         self.token_times = []
+        self._clock = time.monotonic
         self._events = queue.Queue()
 
     def _emit(self, kind, value=None):
         if kind == "token":
-            now = time.monotonic()
+            now = self._clock()
             if self.first_token_time is None:
                 self.first_token_time = now
             self.token_times.append(now)
@@ -198,7 +201,8 @@ class FleetRouter:
                 request._emit("error", request.error)
                 raise engine_lib.RequestError(request.error)
             request.state = "queued"
-            request.arrival = time.monotonic()
+            request._clock = self._clock
+            request.arrival = self._clock()
             self._queue.append(request)
             self._cond.notify_all()
         return request
@@ -362,8 +366,15 @@ class FleetRouter:
             if rep.engine.active_count == 0:
                 break
             time.sleep(0.01)
-        logger.info("fleet: replica %s drained to %d in-flight within "
-                    "its grace budget", name, rep.engine.active_count)
+        remaining = rep.engine.active_count
+        if remaining == 0:
+            logger.info("fleet: replica %s drained within its grace "
+                        "budget", name)
+        else:
+            logger.warning("fleet: replica %s grace budget expired "
+                           "with %d still in flight (they fail over "
+                           "to re-dispatch at eviction)", name,
+                           remaining)
 
     def evict(self, name):
         """Kill the replica NOW. In-flight/queued engine requests fail

@@ -263,6 +263,15 @@ class PrefixCache:
     def size(self):
         return len(self._entries)
 
+    def reclaimable(self):
+        """Blocks :meth:`release` could actually return to the pool
+        right now: entries whose block has no holder besides the cache.
+        An entry a live sequence also references frees nothing when
+        evicted (the sequence's reference keeps the block allocated),
+        so it is not headroom."""
+        return sum(1 for b in self._entries.values()
+                   if self._alloc.ref_count(b) == 1)
+
     def _keys(self, tokens):
         key, out = None, []
         for i in range(len(tokens) // self._bs):

@@ -787,6 +787,7 @@ def test_prefix_cache_chain_match_insert_release():
     blocks = a.alloc(3)
     pc.insert(toks, blocks[:2])           # full blocks only, per contract
     assert all(a.ref_count(b) == 2 for b in blocks[:2])  # cache holds refs
+    assert pc.reclaimable() == 0          # a live holder: eviction frees 0
     n, shared = pc.match(toks)
     assert n == 8 and shared == blocks[:2]
     n2, s2 = pc.match(toks[:7])           # shorter prompt: prefix chain
@@ -799,6 +800,7 @@ def test_prefix_cache_chain_match_insert_release():
     # release-under-pressure evicts LRU entries until `need` fits
     a.free(blocks)                        # drop our refs; cache keeps its 2
     assert a.available == a.capacity - 2
+    assert pc.reclaimable() == 2          # cache is the sole holder now
     pc.release(a.capacity)                # need everything -> evict all
     assert pc.size == 0 and a.available == a.capacity
     assert pc.match(toks) == (0, [])
@@ -870,6 +872,53 @@ def test_engine_prefix_cache_evicts_under_allocator_pressure():
     assert r2.generated == _oracle(model, params, p2, 4)
     assert eng.prefix_cache.match(p1) == (0, [])      # LRU gave blocks up
     assert eng.prefix_cache.match(p2)[0] > 0          # newest prompt cached
+    _assert_no_leak(eng)
+
+
+def test_admit_release_under_pressure_never_frees_matched_blocks():
+    """Regression: admission matched cached prefix blocks, then a
+    release() under KV pressure evicted those very entries (the cache
+    held their only reference), returned the blocks to the free list,
+    and the retry alloc handed them back as fresh WRITABLE blocks —
+    duplicate block-table entries, decode writing into the cached
+    prefix. The match must be pinned before any release; an admission
+    still backpressured after the release drops the pin and retries
+    later."""
+    cfg, model, params = _model()
+    eng = ServeEngine(model, params,
+                      _kv(cfg, num_blocks=10, block_size=4, mbps=8),
+                      max_slots=2, prefill_chunk=4,
+                      registry=MetricsRegistry())
+    p1 = list(range(8))                       # 2 full blocks
+    r1 = eng.generate(p1, 4)                  # 3 blocks; caches 2
+    _run_until(eng, [r1])
+    assert eng.prefix_cache.size == 2
+    assert eng.prefix_cache.reclaimable() == 2    # cache is sole holder
+    # a live sequence takes 4 of the 7 free blocks, so the next one
+    # (6 blocks total: 2 matched + 4 fresh > 3 free) forces release()
+    # to eat into its OWN matched entries
+    r_live = eng.generate([9] * 8, 8)             # blocks_for(16) = 4
+    p3 = p1 + list(range(16, 25))                 # 17 tokens, 6 blocks
+    r3 = eng.generate(p3, 4)
+    for _ in range(200):
+        eng.step()
+        live = [r for r in eng._slots if r is not None]
+        for r in live:
+            # a block table never repeats a block — every position is
+            # distinct KV storage
+            assert len(set(r.blocks)) == len(r.blocks), r.blocks
+        for i, a in enumerate(live):              # p3 shares nothing
+            for b in live[i + 1:]:                # with [9]*8: disjoint
+                assert not set(a.blocks) & set(b.blocks)
+        if all(r.state == "done" for r in (r_live, r3)):
+            break
+    else:
+        raise AssertionError("requests did not finish")
+    assert r_live.generated == _oracle(model, params, r_live.prompt, 8)
+    assert r3.generated == _oracle(model, params, p3, 4)
+    # the pressured admission rescinded its match (pin dropped on the
+    # backpressure path) and later admitted uncached
+    assert r3.cached_prompt_tokens == 0
     _assert_no_leak(eng)
 
 

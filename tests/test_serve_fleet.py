@@ -17,7 +17,7 @@ import jax
 import numpy as np
 import pytest
 
-from test_serve import _kv, _model, _oracle
+from test_serve import _kv, _model, _oracle, _run_until
 
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.serve.engine import RequestError, ServeEngine
@@ -76,6 +76,49 @@ def test_fleet_dispatch_skips_draining_replica_and_counts_states():
         assert h["status"] == "ok" and h["ready_replicas"] == 1
     finally:
         router.stop()
+
+
+def test_replica_headroom_counts_only_sole_ref_cache_entries():
+    """A cache entry whose block a live sequence also maps frees no
+    pool block when released — scoring it as headroom would dispatch a
+    request into engine backpressure while another replica had real
+    room."""
+    from horovod_tpu.serve.fleet.replica import Replica
+
+    cfg, model, params = _model()
+    eng = ServeEngine(model, params,
+                      _kv(cfg, num_blocks=8, block_size=4, mbps=8),
+                      max_slots=2, prefill_chunk=4,
+                      registry=MetricsRegistry())
+    rep = Replica("r", eng)
+    assert rep.headroom_for(7)                    # capacity 7, all free
+    r1 = eng.generate(list(range(8)), 8)          # 4 blocks
+    for _ in range(10):                           # run prefill only
+        eng.step()
+        if r1.state == "decode":
+            break
+    assert eng.prefix_cache.size == 2             # r1's full blocks
+    assert eng.prefix_cache.reclaimable() == 0    # r1 still maps them
+    assert rep.headroom_for(3)                    # the 3 free blocks
+    assert not rep.headroom_for(4)                # cache is NOT headroom
+    _run_until(eng, [r1])
+    assert eng.prefix_cache.reclaimable() == 2    # sole-ref now
+    assert rep.headroom_for(7)                    # free + reclaimable
+
+
+def test_fleet_request_timestamps_use_router_clock():
+    """Client-latency stamps (arrival, token times) follow the
+    router's injectable clock — one time base fleet-wide under a fake
+    clock."""
+    t = [100.0]
+    router = FleetRouter(registry=MetricsRegistry(), clock=lambda: t[0])
+    freq = router.generate([1, 2, 3], 4)
+    assert freq.arrival == 100.0
+    t[0] = 101.5
+    freq._emit("token", 7)
+    assert freq.first_token_time == 101.5
+    assert freq.token_times == [101.5]
+    router.stop()
 
 
 def test_fleet_e2e_chaos_eviction_mid_stream_zero_drop():
