@@ -371,12 +371,15 @@ def spmd_comparison(args):
 
     * **ResNet**: explicit overlap+ZeRO-1 pipeline vs the
       NamedSharding-compiled GSPMD step (``make_train_step(spmd=True)``
-      — no explicit collective calls, XLA inserts the exchange) vs
-      GSPMD-with-wire-compression (which takes the documented fallback
-      through the explicit bucketed pipeline — the compressed exchange
-      has no annotation-only form).
+      — no explicit collective calls, XLA inserts the exchange). With
+      wire formats requested (``--compression``, or the ``--spmd-wire``
+      default), each format adds a head-to-head PAIR: the explicit
+      compressed pipeline (``explicit_wire_<fmt>``) and GSPMD with the
+      compression compiled IN-PLACE (``gspmd_wire_<fmt>`` — the
+      shard_map island for chunked fp8/int8, dtype-narrowed constraints
+      for bf16 casts; ISSUE 17, no fallback).
     * **LM**: the shared ``make_lm_bench`` workload, batch-sharded over
-      the full data mesh — GSPMD and its wire-fallback vs the
+      the full data mesh — GSPMD and the same per-format pairs vs the
       ``explicit`` LM step. The LM path has no overlap+ZeRO pipeline
       (``make_lm_train_step`` reduces via one fused allreduce), so its
       baseline is the explicit fused-AR step and its keys say
@@ -385,12 +388,16 @@ def spmd_comparison(args):
 
     Emits per-variant step times, measured per-device optimizer-state
     bytes (the ZeRO-1 sharding must survive the path change), the
-    compiled-HLO collective byte accounting for the GSPMD builds, and
-    the parity ratios the acceptance gate reads
-    (``gspmd_over_explicit_step_time`` <= 1.02 before GSPMD can become
-    a default). One JSON line, same contract as the headline bench."""
-    import warnings
-
+    compiled-HLO collective byte accounting for the GSPMD builds (the
+    island's alltoall rides the same ``spmd_*`` counters — honest
+    wire-width bytes off the module XLA produced), and the parity
+    ratios the acceptance gates read: ``gspmd_over_explicit_step_time``
+    <= 1.02 before GSPMD can become a default, and per format
+    ``island_over_explicit_wire_<fmt>`` < 1 (the compiled island must
+    beat the explicit compressed pipeline) plus
+    ``island_over_gspmd_<fmt>`` (< 1 only where the wire is the
+    bottleneck — see BENCH_NOTES.md). One JSON line, same contract as
+    the headline bench."""
     import optax
 
     import horovod_tpu as hvd
@@ -403,30 +410,33 @@ def spmd_comparison(args):
     global_batch = args.batch_size * ndev
     images, labels = synthetic_batch(global_batch, args.image_size)
 
+    if args.compression is None:
+        formats = [args.spmd_wire]
+    elif args.compression:
+        formats = [f for f in args.compression if f != "none"]
+    else:  # bare --compression: the documented island matrix
+        formats = ["bf16", "fp8", "int8"]
+
     result = {"metric": f"{args.model}_gspmd_vs_explicit_step_ms",
               "unit": "ms/step", "devices": ndev,
               "per_chip_batch": args.batch_size, "repeats": args.repeats,
-              "spmd_wire": args.spmd_wire}
+              "spmd_wire_formats": formats}
 
     variants = {
         "explicit_overlap_zero1": dict(spmd=False, wire=None),
         "gspmd": dict(spmd=True, wire=None),
-        f"gspmd_wire_{args.spmd_wire}": dict(spmd=True,
-                                             wire=args.spmd_wire),
     }
+    for fmt in formats:
+        variants[f"explicit_wire_{fmt}"] = dict(spmd=False, wire=fmt)
+        variants[f"gspmd_wire_{fmt}"] = dict(spmd=True, wire=fmt)
     for name, kind in variants.items():
         model = make_model(args.model)
         tx = hvd.DistributedOptimizer(optax.adamw(1e-3),
                                       sharded_update=True,
                                       compression=kind["wire"])
-        with warnings.catch_warnings(record=True) as caught:
-            warnings.simplefilter("always")
-            step = training.make_train_step(
-                model, tx, donate=True, spmd=kind["spmd"],
-                overlap_grads=not kind["spmd"])
-        for w in caught:
-            if "falling back" in str(w.message):
-                result[f"note_{name}"] = "bucketed_fallback"
+        step = training.make_train_step(
+            model, tx, donate=True, spmd=kind["spmd"],
+            overlap_grads=not kind["spmd"])
         state = training.create_train_state(model, tx,
                                             jax.random.PRNGKey(0),
                                             images[:1])
@@ -451,18 +461,14 @@ def spmd_comparison(args):
     lm_variants = {
         "explicit": dict(spmd=False, wire=None),
         "gspmd": dict(spmd=True, wire=None),
-        f"gspmd_wire_{args.spmd_wire}": dict(spmd=True,
-                                             wire=args.spmd_wire),
     }
+    for fmt in formats:
+        lm_variants[f"explicit_wire_{fmt}"] = dict(spmd=False, wire=fmt)
+        lm_variants[f"gspmd_wire_{fmt}"] = dict(spmd=True, wire=fmt)
     for name, kind in lm_variants.items():
-        with warnings.catch_warnings(record=True) as caught:
-            warnings.simplefilter("always")
-            step, state, tokens = make_lm_bench(
-                mesh=hvd.mesh(), seq_axis=None, flash=None,
-                spmd=kind["spmd"], compression=kind["wire"], **lm_cfg)
-        for w in caught:
-            if "falling back" in str(w.message):
-                result[f"lm_note_{name}"] = "explicit_fallback"
+        step, state, tokens = make_lm_bench(
+            mesh=hvd.mesh(), seq_axis=None, flash=None,
+            spmd=kind["spmd"], compression=kind["wire"], **lm_cfg)
         state = _record_lm_step_time(args, step, state, tokens, result,
                                      name)
         if getattr(step, "compiled_collectives", None):
@@ -481,6 +487,20 @@ def spmd_comparison(args):
             result[key] = round(got / base, 3)
             result[key + "_parity_within_2pct"] = bool(
                 got / base <= 1.02)
+    # per-format island gates: vs the explicit compressed pipeline
+    # (must win) and vs uncompressed GSPMD (wins where the wire is the
+    # bottleneck)
+    for fmt in formats:
+        for prefix, tag in (("step_ms", ""), ("lm_step_ms", "lm_")):
+            island = result.get(f"{prefix}_gspmd_wire_{fmt}")
+            exp_c = result.get(f"{prefix}_explicit_wire_{fmt}")
+            base = result.get(f"{prefix}_gspmd")
+            if island and exp_c:
+                result[f"{tag}island_over_explicit_wire_{fmt}"] = (
+                    round(island / exp_c, 3))
+            if island and base:
+                result[f"{tag}island_over_gspmd_{fmt}"] = (
+                    round(island / base, 3))
     result["telemetry"] = _telemetry_block()
     _attach_goodput(result)
     print(json.dumps(result))
@@ -908,13 +928,20 @@ def main():
     parser.add_argument("--spmd", action="store_true",
                         help="run ONLY the GSPMD-vs-explicit comparison: "
                              "explicit overlap+ZeRO-1 vs the NamedSharding-"
-                             "compiled GSPMD step vs GSPMD+wire (bucketed "
-                             "fallback), on the ResNet AND LM paths "
-                             "(docs/PERFORMANCE.md, 'The GSPMD path')")
+                             "compiled GSPMD step vs GSPMD+wire compiled "
+                             "IN-PLACE (the shard_map island for chunked "
+                             "formats, dtype-narrowed constraints for "
+                             "casts), head-to-head with the explicit "
+                             "compressed pipeline, on the ResNet AND LM "
+                             "paths (docs/PERFORMANCE.md, 'The GSPMD "
+                             "path'). Combine with --compression to list "
+                             "the wire formats (bare --compression = "
+                             "bf16 fp8 int8)")
     parser.add_argument("--spmd-wire", default="int8",
                         metavar="{bf16,fp8,int8}",
                         help="wire format for the --spmd compressed "
-                             "variant (default int8)")
+                             "variants when --compression is not given "
+                             "(default int8)")
     parser.add_argument("--spmd-lm-d-model", type=int, default=256,
                         help="--spmd LM-path model width (small default "
                              "so the comparison runs on CPU meshes; "
@@ -964,11 +991,11 @@ def main():
     if args.data_plane and (args.overlap or args.compression is not None):
         parser.error("--data-plane is its own comparison mode; run it "
                      "separately from --overlap/--compression")
-    if args.spmd and (args.overlap or args.compression is not None
-                      or args.data_plane):
+    if args.spmd and (args.overlap or args.data_plane):
         parser.error("--spmd is its own comparison mode; run it "
-                     "separately from --overlap/--compression/"
-                     "--data-plane")
+                     "separately from --overlap/--data-plane "
+                     "(--compression composes: it lists the wire "
+                     "formats for the compiled-island variants)")
     if args.churn and (args.overlap or args.compression is not None
                        or args.data_plane or args.spmd):
         parser.error("--churn is its own comparison mode; run it "

@@ -208,7 +208,8 @@ class HorovodOptimizer:
                                                        params)
         return inner_updates, (state[0], inner_state)
 
-    def update_spmd(self, grads, state, params, plan):
+    def update_spmd(self, grads, state, params, plan, wire=None,
+                    ag_residuals=None):
         """The GSPMD-path update (``training.make_train_step(spmd=True)``
         routes here): gradients arrive as the logical GLOBAL-batch mean —
         XLA's inserted collectives already own the reduction — so no
@@ -218,19 +219,30 @@ class HorovodOptimizer:
         transform with the chain structure preserved, so optimizer state
         and checkpoints stay interchangeable with the explicit path.
         Same public ``DistributedOptimizer`` surface — this method is the
-        routing, not a new user contract."""
+        routing, not a new user contract.
+
+        ``wire``/``ag_residuals`` thread a CAST wire format (and its
+        delta error-feedback carry) into the ZeRO-1 constraint exchange
+        — see ``apply_shards_spmd``; chunked quantizers never reach
+        here (the train step compiles them as a shard_map island)."""
         if self.sharded_update:
             from horovod_tpu.parallel import gspmd
             if params is None:
                 raise ValueError("sharded_update needs params: "
                                  "tx.update_spmd(grads, state, params, plan)")
             return gspmd.apply_shards_spmd(self.inner, grads, state,
-                                           params, plan)
+                                           params, plan, wire=wire,
+                                           ag_residuals=ag_residuals)
         if self.backward_passes_per_step > 1:
             raise ValueError(
                 "backward_passes_per_step>1 has no GSPMD path — its "
                 "accumulator lives in the explicit pipeline; use "
                 "make_train_step(accum_steps=...) there")
+        if wire is not None or ag_residuals is not None:
+            raise ValueError(
+                "wire=/ag_residuals= narrow the ZeRO-1 "
+                "(sharded_update=True) constraint exchange; the plain "
+                "path's cast narrowing lives in the train step itself")
         return self.update_preaveraged(grads, state, params)
 
     def _hierarchical_resolved(self):

@@ -46,6 +46,7 @@ import dataclasses
 import re
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -152,7 +153,27 @@ def constrain(x, plan, spec):
     return jax.lax.with_sharding_constraint(x, plan.sharding(spec))
 
 
-def apply_shards_spmd(tx, grads, zstate, params, plan):
+def shard_map_island(fn, plan, in_specs, out_specs):
+    """The SANCTIONED ``shard_map`` entry point of the GSPMD hot path:
+    a per-shard region embedded INSIDE the jitted step, over the plan's
+    mesh. The chunked quantized exchange (fp8/int8 wires) needs
+    per-device partial gradients and per-chunk scales — values no
+    sharding annotation can express — so the compressed
+    reduce-scatter/all-gather cycle runs as this island while XLA's
+    latency-hiding scheduler still owns the schedule of the surrounding
+    program (``training._make_spmd_train_step`` is the consumer; the
+    compiled module's collectives are accounted by the same HLO parser
+    as the annotation-only path). Mesh-ratchet status: this helper lives
+    in ``parallel/gspmd.py`` — one of hvd-lint HVD-MESH's excluded shim
+    layers — precisely so the island call sites in ``training.py`` go
+    through a named, reviewed entry point instead of growing new raw
+    ``shard_map(`` sites (``analysis/rules/mesh.py``)."""
+    return jax.shard_map(fn, mesh=plan.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def apply_shards_spmd(tx, grads, zstate, params, plan, wire=None,
+                      ag_residuals=None):
     """ZeRO-1 under GSPMD: the sharding-annotation replacement for
     ``zero.sharded_update`` — identical ``[world, shard]`` bucket-row
     layout and identical inner-optimizer math, but **zero explicit
@@ -171,9 +192,38 @@ def apply_shards_spmd(tx, grads, zstate, params, plan):
 
     Returns ``(updates, new_zstate)`` with ``updates`` shaped like
     ``params``. The inner state structure matches the explicit path's
-    exactly, so checkpoints restore across paths unchanged."""
+    exactly, so checkpoints restore across paths unchanged.
+
+    ``wire`` (a CAST compressor — bf16/float16) narrows both halves of
+    the exchange by dtype-narrowed constraints: gradient rows are cast
+    to the wire dtype BEFORE the row constraint (the pending reduction
+    plus a sharded consumer at the narrow dtype lets the partitioner
+    move the reduce-scatter's bytes at wire width), and the updated
+    parameter-delta rows are cast before the replicated constraint (the
+    implied all-gather genuinely moves wire-width bytes). Chunked
+    quantizers (fp8/int8) are REJECTED here: per-chunk scales have no
+    annotation-only form — that exchange is the :func:`shard_map_island`
+    that ``training._make_spmd_train_step`` compiles instead.
+
+    ``ag_residuals`` (per-bucket ``[world, shard]`` fp32 arrays, sharded
+    over the schedule axes) turns on delta error feedback for the
+    all-gather half only: the cast error of each delta row is carried
+    into the next step's row before narrowing. The reduce-scatter half
+    stays stateless by construction — a carried residual would have to
+    be added to the still-unreduced logical gradient, forcing the
+    reduction to complete BEFORE the narrowing cast and defeating the
+    annotation. With ``ag_residuals`` the return grows to
+    ``(updates, new_zstate, new_ag_residuals)``."""
     from horovod_tpu.ops import fusion
     from horovod_tpu.parallel import zero as zero_lib
+
+    if wire is not None and getattr(wire, "chunked", False):
+        raise ValueError(
+            f"chunked wire format {wire.name!r} has no annotation-only "
+            "form (per-chunk scales cannot ride a sharding constraint) "
+            "— the quantized exchange is the shard_map island that "
+            "training.make_train_step(spmd=True) compiles into the jit "
+            "step; this constraint path narrows cast wires only")
 
     schedule = zstate.plan.schedule
     row_spec = P(tuple(schedule.axes))
@@ -186,16 +236,48 @@ def apply_shards_spmd(tx, grads, zstate, params, plan):
             "different parameter tree?")
     grad_rows, param_rows = {}, {}
     for i in range(len(schedule.buckets)):
-        grad_rows[f"b{i}"] = constrain(
-            zero_lib.bucket_rows(schedule, i, grad_leaves), plan, row_spec)
+        rows = zero_lib.bucket_rows(schedule, i, grad_leaves)
+        if wire is not None and jnp.issubdtype(rows.dtype, jnp.floating):
+            # dtype-narrowed constraint: cast the (still logically
+            # unreduced) rows to the wire dtype, then ask for the row
+            # sharding — the partitioner owns where the reduce-scatter
+            # lands, and the narrow producer lets it move wire-width
+            # bytes; decode is the cast back for the fp32 update math
+            grad_dtype = rows.dtype
+            rows = constrain(rows.astype(wire.wire_dtype), plan,
+                             row_spec).astype(grad_dtype)
+            grad_rows[f"b{i}"] = rows
+        else:
+            grad_rows[f"b{i}"] = constrain(rows, plan, row_spec)
         param_rows[f"b{i}"] = constrain(
             zero_lib.bucket_rows(schedule, i, leaves), plan, row_spec)
     update_rows, new_inner = tx.update(grad_rows, zstate.inner, param_rows)
 
+    new_residuals = list(ag_residuals) if ag_residuals is not None else None
     new_leaves = [None] * len(leaves)
     for i in range(len(schedule.buckets)):
         rows = constrain(update_rows[f"b{i}"], plan, row_spec)
-        flat = constrain(rows.reshape(-1), plan, P())
+        if wire is not None and jnp.issubdtype(rows.dtype, jnp.floating):
+            # narrow the delta all-gather: each rank's [world, shard]
+            # rows are cast to the wire dtype while still sharded, the
+            # replicated constraint gathers the narrow bytes, and every
+            # rank decodes the same values — params stay replicated-
+            # consistent. Delta-EF compensates the cast error per row.
+            out_dtype = rows.dtype
+            x = rows
+            if new_residuals is not None and new_residuals[i].size:
+                x = x.astype(jnp.float32) + new_residuals[i].reshape(
+                    x.shape)
+                wire_rows = x.astype(wire.wire_dtype)
+                new_residuals[i] = constrain(
+                    x - wire_rows.astype(jnp.float32), plan, row_spec)
+            else:
+                wire_rows = x.astype(wire.wire_dtype)
+            wire_rows = constrain(wire_rows, plan, row_spec)
+            flat = constrain(wire_rows.reshape(-1), plan,
+                             P()).astype(out_dtype)
+        else:
+            flat = constrain(rows.reshape(-1), plan, P())
         for j, arr in fusion.unpack_bucket(schedule, i, flat,
                                            leaves).items():
             new_leaves[j] = arr
@@ -205,7 +287,10 @@ def apply_shards_spmd(tx, grads, zstate, params, plan):
             f"ZeRO plan does not cover gradient leaves {missing}; was "
             "the optimizer initialized with a different parameter tree?")
     updates = jax.tree_util.tree_unflatten(treedef, new_leaves)
-    return updates, zero_lib.ZeroState(new_inner, zstate.plan)
+    new_zstate = zero_lib.ZeroState(new_inner, zstate.plan)
+    if new_residuals is not None:
+        return updates, new_zstate, new_residuals
+    return updates, new_zstate
 
 
 # -- compiled-HLO byte accounting -------------------------------------------

@@ -127,9 +127,12 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
     BatchNorm normalizes with GLOBAL-batch statistics (sync-BN; the
     explicit path is per-shard), and dropout draws one global stream.
     ``accum_steps``/``overlap_grads`` are the explicit pipeline's knobs
-    and are rejected here; a wire-compressed optimizer falls back to
-    the explicit bucketed pipeline with a warning (the quantized
-    exchange has no annotation-only form — docs/PERFORMANCE.md).
+    and are rejected here; a wire-compressed optimizer compiles the
+    compression IN-PLACE — chunked quantizers (fp8/int8) as a
+    ``shard_map`` island inside the jitted program (which restores the
+    explicit path's per-shard BN/dropout semantics for that build),
+    cast wires (bf16/float16) as dtype-narrowed sharding constraints
+    that keep the annotation-only program — docs/PERFORMANCE.md.
 
     Returns ``step(state, inputs, labels) -> (state, loss)`` where
     ``inputs``/``labels`` are global arrays whose leading (batch) dim is
@@ -642,7 +645,8 @@ def make_train_step(model, tx, mesh=None, loss_fn=softmax_cross_entropy,
 def _spmd_gate(tx, what):
     """Shared validation for the GSPMD builders: version support and the
     optimizer contract. Returns the resolved wire format (``None`` or a
-    compressor — the caller decides the fallback)."""
+    compressor — the caller compiles it in-place: the shard_map island
+    for chunked quantizers, dtype-narrowed constraints for casts)."""
     from horovod_tpu import compat, hvd_jax
 
     ok, reason = compat.gspmd_supported()
@@ -677,17 +681,27 @@ class _SpmdProgram:
     lower. One copy, so a fix to either flavor cannot miss the other.
 
     ``arg_specs`` are the PartitionSpecs of the non-state args (batch
-    leaves); ``n_scalar_outs`` counts the replicated scalar outputs
-    after the state (loss, optional grad norm)."""
+    leaves; each entry may be a pytree PREFIX for its argument — a
+    single spec covers a whole subtree, which is how the wire-residual
+    dict rides as one argument); ``n_scalar_outs`` counts the
+    replicated scalar outputs after the state (loss, optional grad
+    norm). ``aux_out_specs`` are specs for outputs BETWEEN the state
+    and the scalars (the new wire-residual tree, sharded like its
+    input); ``extra_donate`` names additional donated argnums (the
+    residuals are dead after each step — donating them keeps the EF
+    carry HBM-neutral, same as the explicit path's ``donate_argnums=
+    (0, 1)``)."""
 
     def __init__(self, plan, global_step, arg_specs, n_scalar_outs,
-                 donate):
+                 donate, aux_out_specs=(), extra_donate=()):
         from horovod_tpu.parallel import gspmd as gspmd_lib
 
         self.plan = plan
         self._fn = global_step
         self._arg_specs = tuple(arg_specs)
         self._n_out = int(n_scalar_outs)
+        self._aux_out_specs = tuple(aux_out_specs)
+        self._extra_donate = tuple(extra_donate)
         self._donate = donate
         self.jitted = None
         self.state_shardings = None
@@ -705,9 +719,11 @@ class _SpmdProgram:
                 self._fn,
                 in_shardings=(self.state_shardings,) + tuple(
                     self.plan.sharding(s) for s in self._arg_specs),
-                out_shardings=(self.state_shardings,) + (rep,)
-                * self._n_out,
-                donate_argnums=(0,) if self._donate else ())
+                out_shardings=(self.state_shardings,) + tuple(
+                    self.plan.sharding(s) for s in self._aux_out_specs)
+                + (rep,) * self._n_out,
+                donate_argnums=((0,) + self._extra_donate
+                                if self._donate else ()))
         return self.jitted
 
     def executable(self, placed):
@@ -735,29 +751,33 @@ class _SpmdProgram:
         return self.jitted_for(placed[0]).lower(*placed)
 
 
-def _spmd_wire_drift_checker(tx):
+def _spmd_wire_drift_checker(tx, wire):
     """Per-step guard mirroring the explicit path's _check_wire_drift:
-    the GSPMD builders resolve the wire format ONCE at build (non-None
-    routes to the explicit fallback), but config.wire_dtype binds late —
-    an autotuner that installs its winner AFTER the step was built would
-    otherwise leave tx.compression claiming a format the running
-    program never applies. Warn once instead of silently diverging."""
+    the GSPMD builders resolve the wire format ONCE at build and
+    compile it into the program (the chunked shard_map island, the
+    cast-narrowed constraints, or neither), but config.wire_dtype binds
+    late — an autotuner that installs its winner AFTER the step was
+    built would otherwise leave tx.compression claiming a format the
+    running program never applies (or vice versa). Warn once, in either
+    drift direction, instead of silently diverging."""
     warned = [False]
 
     def check():
         if warned[0]:
             return
         now = tx.compression
-        if now is not None:
+        if now is not wire:
             warned[0] = True
             import warnings
+            built = (f"built with {wire.name!r}" if wire is not None
+                     else "built uncompressed")
             warnings.warn(
                 f"tx.compression now resolves to "
                 f"{getattr(now, 'name', None)!r} but this GSPMD step was "
-                "built uncompressed — the wire decision is made at build "
-                "time (a compressed build runs the explicit bucketed "
-                "fallback). Rebuild the step after installing "
-                "config.wire_dtype for it to take effect.", stacklevel=3)
+                f"{built} — the wire format is compiled into the program "
+                "at make_train_step time. Rebuild the step after "
+                "installing config.wire_dtype for the new format to "
+                "take effect.", stacklevel=3)
 
     return check
 
@@ -768,34 +788,37 @@ def _make_spmd_train_step(model, tx, mesh=None,
                           overlap_grads=False, telemetry=None,
                           error_feedback=True, loader=None):
     """The GSPMD hot path behind ``make_train_step(spmd=True)`` — see
-    that docstring and ``parallel/gspmd.py`` for the contract."""
+    that docstring and ``parallel/gspmd.py`` for the contract.
+
+    Wire compression compiles IN-PLACE (no fallback):
+
+    * **Chunked quantizers** (fp8/int8) need per-device partial
+      gradients and per-chunk scales, which no annotation can express —
+      so the per-shard forward/backward + quantized bucket exchange +
+      optimizer tail run as ONE ``shard_map`` island
+      (``gspmd.shard_map_island``) inside the jitted program. XLA's
+      latency-hiding scheduler still owns the schedule; the wire moves
+      narrow bytes (all-to-all of int8/fp8 rows + fp32 scales).
+      Semantics inside the island are the EXPLICIT path's: per-shard
+      BatchNorm statistics (averaged after) and per-shard dropout
+      streams — not the annotation path's sync-BN/global stream.
+    * **Cast wires** (bf16/float16) keep the annotation-only global
+      program (sync-BN, one dropout stream): ZeRO-1's constraint
+      exchange narrows both halves by dtype-narrowed constraints
+      (``gspmd.apply_shards_spmd(wire=...)``, with delta-EF on the
+      all-gather half); the plain-DP path round-trips the logical
+      gradient through the wire dtype as a convert-sinking hint.
+    * ``wire is None`` compiles the byte-identical uncompressed program
+      (the wire-residual argument is an empty pytree — zero buffers).
+    """
     import time as _time
-    import warnings
 
     from horovod_tpu import telemetry as telemetry_lib
+    from horovod_tpu.ops import fusion
     from horovod_tpu.parallel import gspmd as gspmd_lib
+    from horovod_tpu.parallel import zero as zero_lib
 
     wire = _spmd_gate(tx, "make_train_step")
-    if wire is not None:
-        # documented fallback (docs/PERFORMANCE.md, "The GSPMD path"):
-        # the quantized exchange carries per-chunk scales no sharding
-        # annotation can express, and a cast-width constraint cannot
-        # force the partitioner to MOVE bytes at the narrow width (the
-        # reduction happens where AD put it, before any cast) — so a
-        # wire-compressed optimizer runs the explicit bucketed pipeline,
-        # which implements exactly that exchange.
-        warnings.warn(
-            f"make_train_step(spmd=True) with wire compression "
-            f"({wire.name!r}): the quantize-RS-dequantize exchange has "
-            "no annotation-only form — falling back to the explicit "
-            "bucketed pipeline (overlap_grads=True; docs/PERFORMANCE.md"
-            ", 'The GSPMD path').", stacklevel=3)
-        return make_train_step(
-            model, tx, mesh=mesh, loss_fn=loss_fn, batch_axes=batch_axes,
-            donate=donate, dropout_seed=dropout_seed,
-            accum_steps=max(1, accum_steps), overlap_grads=True,
-            telemetry=telemetry, error_feedback=error_feedback,
-            loader=loader, spmd=False)
     if accum_steps != 1 or overlap_grads:
         raise ValueError(
             "accum_steps/overlap_grads are the explicit pipeline's "
@@ -810,42 +833,201 @@ def _make_spmd_train_step(model, tx, mesh=None,
     data_axes = tuple(batch_axes) if batch_axes else plan.data_axes
     batch_spec = P(data_axes)
 
-    def global_step(state, inputs, labels):
-        # ONE global dropout stream per step: there is no per-shard rank
-        # to fold in — masks are drawn over the global batch (the
-        # explicit path draws per-shard streams; docs/PERFORMANCE.md)
-        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
-                                 state.step)
+    sharded_tx = tx.sharded_update
+    reduce_axes = (tuple(tx.axes) if tx.axes is not None else data_axes)
+    chunked = wire is not None and getattr(wire, "chunked", False)
+    # EF carries exist where a step-to-step residual is well-defined:
+    # both halves of the chunked island exchange, and the delta
+    # all-gather of the cast+ZeRO-1 annotation path. The cast plain-DP
+    # hint is stateless (a residual would have to be added to the
+    # still-unreduced logical gradient — see apply_shards_spmd).
+    use_ef = (wire is not None and error_feedback
+              and (chunked or sharded_tx))
+    wire_spec = P(tuple(reduce_axes))
 
-        def compute_loss(params):
-            variables = {"params": params}
-            if state.batch_stats:
-                variables["batch_stats"] = state.batch_stats
-                logits, mutated = model.apply(
-                    variables, inputs, train=True,
-                    mutable=["batch_stats"], rngs={"dropout": rng})
-                return loss_fn(logits, labels), mutated["batch_stats"]
-            logits = model.apply(variables, inputs, train=True,
-                                 rngs={"dropout": rng})
-            return loss_fn(logits, labels), {}
+    def _grad_schedule(params, world):
+        return fusion.bucket_schedule(
+            jax.tree_util.tree_leaves(params), world=world,
+            threshold_bytes=tx.threshold_bytes, axes=reduce_axes,
+            hierarchical=tx._hierarchical_resolved())
 
-        (loss, stats), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(state.params)
-        gnorm = None
-        if tele_on:
-            # grads are the logical global-mean gradient — this is its
-            # exact L2 norm (same definition as the overlapped path)
-            gnorm = jnp.sqrt(sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree_util.tree_leaves(grads)))
-        updates, opt_state = tx.update_spmd(grads, state.opt_state,
-                                            state.params, plan)
-        params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(params=params, opt_state=opt_state,
-                               batch_stats=stats, step=state.step + 1)
-        if tele_on:
-            return new_state, loss, gnorm
-        return new_state, loss
+    if chunked:
+        def local_step(state, wire_state, inputs, labels):
+            # the shard_map island: per-shard forward/backward feeding
+            # the chunked quantize->alltoall->dequantize bucket exchange
+            # — the same data plane as the explicit overlap pipeline,
+            # but compiled INSIDE the GSPMD jit step so the surrounding
+            # program (and its scheduler) stays XLA's. Residual rows
+            # arrive as this shard's [1, n] slice of the [world, n]
+            # global carry; squeeze for the bucket ops.
+            rs_res = [r[0] for r in wire_state.get("rs", ())]
+            ag_res = [r[0] for r in wire_state.get("ag", ())]
+            # per-step AND per-shard dropout stream — explicit-path
+            # semantics (each rank draws independent masks)
+            rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
+                                   state.step),
+                collective.mesh_rank(data_axes))
+
+            def compute_loss(params):
+                variables = {"params": params}
+                if state.batch_stats:
+                    variables["batch_stats"] = state.batch_stats
+                    logits, mutated = model.apply(
+                        variables, inputs, train=True,
+                        mutable=["batch_stats"], rngs={"dropout": rng})
+                    return loss_fn(logits, labels), mutated["batch_stats"]
+                logits = model.apply(variables, inputs, train=True,
+                                     rngs={"dropout": rng})
+                return loss_fn(logits, labels), {}
+
+            (loss, stats), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(state.params)
+
+            if sharded_tx:
+                # the optimizer-state partition IS the bucket schedule
+                schedule = state.opt_state.plan.schedule
+                rs_op = state.opt_state.plan.op
+            else:
+                schedule = _grad_schedule(
+                    state.params, collective.mesh_size(reduce_axes))
+                rs_op = tx.op
+            leaves_g = jax.tree_util.tree_leaves(grads)
+            shards = []
+            for i in range(len(schedule.buckets)):
+                s, new_r = fusion.reduce_scatter_bucket_compressed(
+                    schedule, i, leaves_g, wire, op=rs_op,
+                    residual=(rs_res[i] if use_ef else None))
+                if use_ef:
+                    rs_res[i] = new_r
+                shards.append(s)
+            gnorm = None
+            if tele_on:
+                # shards partition the globally-averaged gradient: the
+                # psum of shard sum-squares IS its exact norm²
+                local_sq = sum(jnp.sum(jnp.square(s.astype(jnp.float32)))
+                               for s in shards)
+                gnorm = jnp.sqrt(collective.allreduce(
+                    local_sq, op=collective.Sum, axes=reduce_axes))
+            if sharded_tx:
+                grad_rows = {f"b{i}": s[None]
+                             for i, s in enumerate(shards)}
+                if use_ef:
+                    updates, opt_state, ag_res = zero_lib.apply_shards(
+                        tx.inner, grad_rows, state.opt_state,
+                        state.params, wire=wire, ag_residuals=ag_res)
+                else:
+                    updates, opt_state = zero_lib.apply_shards(
+                        tx.inner, grad_rows, state.opt_state,
+                        state.params, wire=wire)
+            else:
+                leaves_p, treedef = jax.tree_util.tree_flatten(
+                    state.params)
+                new_leaves = [None] * len(leaves_p)
+                for i, s in enumerate(shards):
+                    flat, new_r = fusion.all_gather_bucket_compressed(
+                        schedule, i, s, wire,
+                        residual=ag_res[i] if use_ef else None)
+                    if use_ef:
+                        ag_res[i] = new_r
+                    for j, arr in fusion.unpack_bucket(
+                            schedule, i, flat, leaves_p).items():
+                        new_leaves[j] = arr
+                grads_full = jax.tree_util.tree_unflatten(treedef,
+                                                          new_leaves)
+                updates, opt_state = tx.update_preaveraged(
+                    grads_full, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            if stats:
+                stats = jax.tree_util.tree_map(
+                    lambda x: collective.allreduce(
+                        x, op=collective.Average, axes=data_axes), stats)
+            loss = collective.allreduce(loss, op=collective.Average,
+                                        axes=data_axes)
+            new_state = TrainState(params=params, opt_state=opt_state,
+                                   batch_stats=stats,
+                                   step=state.step + 1)
+            new_wire = {"rs": [r[None] for r in rs_res],
+                        "ag": [r[None] for r in ag_res]}
+            if tele_on:
+                return new_state, new_wire, loss, gnorm
+            return new_state, new_wire, loss
+
+        def global_step(state, wire_state, inputs, labels):
+            specs = state_specs(state)
+            wspecs = jax.tree_util.tree_map(lambda _: wire_spec,
+                                            wire_state)
+            out_specs = ((specs, wspecs, P(), P()) if tele_on
+                         else (specs, wspecs, P()))
+            island = gspmd_lib.shard_map_island(
+                local_step, plan,
+                in_specs=(specs, wspecs, batch_spec, batch_spec),
+                out_specs=out_specs)
+            return island(state, wire_state, inputs, labels)
+    else:
+        def global_step(state, wire_state, inputs, labels):
+            # ONE global dropout stream per step: there is no per-shard
+            # rank to fold in — masks are drawn over the global batch
+            # (the explicit path draws per-shard streams;
+            # docs/PERFORMANCE.md)
+            rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
+                                     state.step)
+
+            def compute_loss(params):
+                variables = {"params": params}
+                if state.batch_stats:
+                    variables["batch_stats"] = state.batch_stats
+                    logits, mutated = model.apply(
+                        variables, inputs, train=True,
+                        mutable=["batch_stats"], rngs={"dropout": rng})
+                    return loss_fn(logits, labels), mutated["batch_stats"]
+                logits = model.apply(variables, inputs, train=True,
+                                     rngs={"dropout": rng})
+                return loss_fn(logits, labels), {}
+
+            (loss, stats), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(state.params)
+            if wire is not None and not sharded_tx:
+                # plain DP has no sharded consumer to hang a narrow
+                # constraint on: round-trip the logical gradient through
+                # the wire dtype — the applied update carries the wire
+                # precision, and the convert adjacent to XLA's inserted
+                # all-reduce is the cue for sinking the reduction to the
+                # narrow width where the backend can
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(wire.wire_dtype).astype(g.dtype)
+                               if jnp.issubdtype(g.dtype, jnp.floating)
+                               else g), grads)
+            gnorm = None
+            if tele_on:
+                # grads are the logical global-mean gradient — this is
+                # its exact L2 norm (same definition as the overlapped
+                # path)
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)))
+            if wire is not None and sharded_tx:
+                ag_res = list(wire_state.get("ag", ()))
+                if use_ef:
+                    updates, opt_state, ag_res = tx.update_spmd(
+                        grads, state.opt_state, state.params, plan,
+                        wire=wire, ag_residuals=ag_res)
+                else:
+                    updates, opt_state = tx.update_spmd(
+                        grads, state.opt_state, state.params, plan,
+                        wire=wire)
+                new_wire = {"rs": [], "ag": ag_res if use_ef else []}
+            else:
+                updates, opt_state = tx.update_spmd(
+                    grads, state.opt_state, state.params, plan)
+                new_wire = {"rs": [], "ag": []}
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(params=params, opt_state=opt_state,
+                                   batch_stats=stats,
+                                   step=state.step + 1)
+            if tele_on:
+                return new_state, new_wire, loss, gnorm
+            return new_state, new_wire, loss
 
     place_data = _placer(mesh, batch_spec)
 
@@ -880,11 +1062,78 @@ def _make_spmd_train_step(model, tx, mesh=None,
                 f"batches for this step; got {type(batch).__name__}")
         return batch[0], batch[1]
 
-    prog = _SpmdProgram(plan, global_step,
-                        arg_specs=(batch_spec, batch_spec),
-                        n_scalar_outs=2 if tele_on else 1,
-                        donate=donate)
-    _check_wire_drift = _spmd_wire_drift_checker(tx)
+    # the wire-residual carry (error feedback on) rides as ONE extra
+    # jit argument — a dict of per-bucket [world, n] fp32 arrays,
+    # sharded over the scatter axes — and comes back as the matching
+    # extra output. With EF off (including compression off) the
+    # argument is OMITTED entirely, keeping the program — down to its
+    # result metadata — byte-identical to a build with no wire
+    # plumbing at all.
+    if use_ef:
+        prog = _SpmdProgram(plan, global_step,
+                            arg_specs=(wire_spec, batch_spec, batch_spec),
+                            n_scalar_outs=2 if tele_on else 1,
+                            donate=donate,
+                            aux_out_specs=(wire_spec,),
+                            extra_donate=(1,))
+    else:
+        def _global_step_stateless(state, inputs, labels):
+            out = global_step(state, {"rs": [], "ag": []}, inputs,
+                              labels)
+            return (out[0],) + out[2:]  # drop the empty wire slot
+
+        # keep the jitted module's name (jit_global_step) — the
+        # compression-off program must be byte-identical, debug
+        # metadata included
+        _global_step_stateless.__name__ = "global_step"
+        _global_step_stateless.__qualname__ = global_step.__qualname__
+        prog = _SpmdProgram(plan, _global_step_stateless,
+                            arg_specs=(batch_spec, batch_spec),
+                            n_scalar_outs=2 if tele_on else 1,
+                            donate=donate)
+    _check_wire_drift = _spmd_wire_drift_checker(tx, wire)
+
+    _wire_holder = [None]
+
+    def _wire_state_for(state):
+        """Zero-initialized residual buffers ([world, n] global, row r =
+        rank r's carry), rebuilt lazily from the live state —
+        rebuildable by construction, so never checkpointed. The chunked
+        island carries both exchange halves; the cast+ZeRO-1 annotation
+        path carries the delta all-gather half only."""
+        if sharded_tx:
+            schedule = state.opt_state.plan.schedule
+        else:
+            mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+            schedule = _grad_schedule(
+                state.params,
+                int(np.prod([mesh_shape[a] for a in reduce_axes])))
+        w = schedule.world
+
+        def size_or_zero(i, n):
+            # non-float buckets are never quantized — zero-width buffer
+            # keeps per-bucket index alignment without dead HBM traffic
+            return n if jnp.issubdtype(schedule.buckets[i].dtype,
+                                       jnp.floating) else 0
+
+        rs = ([jnp.zeros((w, size_or_zero(i, p)), jnp.float32)
+               for i, p in enumerate(schedule.padded_sizes)]
+              if chunked else [])
+        ag = [jnp.zeros((w, size_or_zero(i, s)), jnp.float32)
+              for i, s in enumerate(schedule.shard_sizes)]
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, plan.sharding(wire_spec)),
+            {"rs": rs, "ag": ag})
+
+    def _wire_state(state):
+        if _wire_holder[0] is None:
+            _wire_holder[0] = _wire_state_for(state)
+        return _wire_holder[0]
+
+    def _reset_error_feedback():
+        """Drop the carried residuals; the next step rebuilds zeros
+        (call after rolling ``state`` back to an earlier commit)."""
+        _wire_holder[0] = None
 
     from horovod_tpu.diag import recorder as _flightrec
     from horovod_tpu.telemetry import ledger as _ledger_lib
@@ -898,18 +1147,32 @@ def _make_spmd_train_step(model, tx, mesh=None,
         n = _step_no[0]
         _step_no[0] = n + 1
         _flightrec.step_begin(n)
-        placed = (place_state(state), place_data(inputs),
-                  place_data(labels))
+        if use_ef:
+            placed = (place_state(state), _wire_state(state),
+                      place_data(inputs), place_data(labels))
+        else:
+            placed = (place_state(state), place_data(inputs),
+                      place_data(labels))
         _check_wire_drift()
         ex = prog.executable(placed)  # one compile per shape signature
         step.jitted = prog.jitted
         step.compiled_collectives = prog.compiled_collectives
         t0 = _time.perf_counter()
-        if tele_on:
-            new_state, loss, gnorm = ex(*placed)
+        try:
+            outs = ex(*placed)
+        except BaseException:
+            # the residuals were donated into the failed dispatch —
+            # drop them so a retry rebuilds zeros instead of dying on
+            # deleted arrays
+            _wire_holder[0] = None
+            raise
+        if use_ef:
+            new_state, rest = outs[0], outs[2:]
+            _wire_holder[0] = outs[1]
         else:
-            new_state, loss = ex(*placed)
-            gnorm = None
+            new_state, rest = outs[0], outs[1:]
+        loss = rest[0]
+        gnorm = rest[1] if tele_on else None
         _flightrec.step_end(n)
         _ledger_lib.get_ledger().settle_step()
         if instruments is not None:
@@ -921,8 +1184,12 @@ def _make_spmd_train_step(model, tx, mesh=None,
         return new_state, loss
 
     def lower(state, inputs, labels):
-        placed = (place_state(state), place_data(inputs),
-                  place_data(labels))
+        if use_ef:
+            placed = (place_state(state), _wire_state(state),
+                      place_data(inputs), place_data(labels))
+        else:
+            placed = (place_state(state), place_data(inputs),
+                      place_data(labels))
         lowered = prog.lower(placed)
         step.jitted = prog.jitted
         return lowered
@@ -931,6 +1198,7 @@ def _make_spmd_train_step(model, tx, mesh=None,
         step.instruments = instruments
     step.jitted = None  # set at first build
     step.lower = lower
+    step.reset_error_feedback = _reset_error_feedback
     step.loader = loader
     step.place_data = place_data
     step.plan = plan
@@ -943,51 +1211,127 @@ def _make_spmd_train_step(model, tx, mesh=None,
 def _make_spmd_lm_train_step(model, tx, mesh=None, batch_axis="data",
                              donate=True):
     """The GSPMD LM step behind ``make_lm_train_step(spmd=True)``:
-    global next-token mean loss over the batch-sharded tokens, gradients
-    reduced by XLA from the shardings, no explicit collective calls."""
+    next-token mean loss over the batch-sharded tokens.
+
+    Wire compression compiles IN-PLACE, mirroring
+    ``_make_spmd_train_step``: chunked quantizers (fp8/int8) run the
+    per-shard forward/backward + quantized bucket exchange as a
+    ``shard_map`` island inside the jitted program; cast wires keep the
+    annotation-only global program (dtype-narrowed constraints under
+    ZeRO-1, a round-trip convert hint under plain DP). LM compression
+    is STATELESS — no error-feedback carry — matching the explicit LM
+    step's ``fused_allreduce`` route, so ``step(state, tokens)`` keeps
+    its two-argument signature and the two builds stay head-to-head
+    comparable in ``bench.py``."""
+    from horovod_tpu.ops import fusion
     from horovod_tpu.parallel import gspmd as gspmd_lib
+    from horovod_tpu.parallel import zero as zero_lib
 
     wire = _spmd_gate(tx, "make_lm_train_step")
-    if wire is not None:
-        # same documented fallback as the classification builder: the
-        # compressed exchange has no annotation-only form, so the
-        # explicit LM step (whose fused allreduce narrows to the wire
-        # format) carries the request
-        import warnings
-        warnings.warn(
-            f"make_lm_train_step(spmd=True) with wire compression "
-            f"({wire.name!r}): falling back to the explicit LM step "
-            "(docs/PERFORMANCE.md, 'The GSPMD path').", stacklevel=3)
-        return make_lm_train_step(model, tx, mesh=mesh,
-                                  batch_axis=batch_axis, seq_axis=None,
-                                  donate=donate, spmd=False)
     mesh = mesh if mesh is not None else mesh_lib.get_mesh()
     plan = gspmd_lib.derive_plan(mesh)
     token_spec = P(batch_axis)
+    sharded_tx = tx.sharded_update
+    reduce_axes = (tuple(tx.axes) if tx.axes is not None
+                   else (batch_axis,))
+    chunked = wire is not None and getattr(wire, "chunked", False)
 
-    def global_step(state, tokens):
-        def compute_loss(params):
-            logits = model.apply({"params": params}, tokens)
-            targets = tokens[:, 1:]
-            logits_t = (logits[:, :-1]
-                        if targets.shape[1] == logits.shape[1] - 1
-                        else logits)
-            logp = jax.nn.log_softmax(logits_t.astype(jnp.float32),
-                                      axis=-1)
-            ll = jnp.take_along_axis(logp, targets[..., None],
-                                     axis=-1)[..., 0]
+    def _local_loss(params, tokens):
+        logits = model.apply({"params": params}, tokens)
+        targets = tokens[:, 1:]
+        logits_t = (logits[:, :-1]
+                    if targets.shape[1] == logits.shape[1] - 1
+                    else logits)
+        logp = jax.nn.log_softmax(logits_t.astype(jnp.float32),
+                                  axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None],
+                                 axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    if chunked:
+        def local_step(state, tokens):
+            # the shard_map island (see _make_spmd_train_step): the
+            # per-shard mean over an equal token shard, averaged across
+            # shards, IS the exact global mean
+            loss, grads = jax.value_and_grad(_local_loss)(state.params,
+                                                          tokens)
+            if sharded_tx:
+                schedule = state.opt_state.plan.schedule
+                rs_op = state.opt_state.plan.op
+            else:
+                schedule = fusion.bucket_schedule(
+                    jax.tree_util.tree_leaves(state.params),
+                    world=collective.mesh_size(reduce_axes),
+                    threshold_bytes=tx.threshold_bytes,
+                    axes=reduce_axes,
+                    hierarchical=tx._hierarchical_resolved())
+                rs_op = tx.op
+            leaves_g = jax.tree_util.tree_leaves(grads)
+            shards = []
+            for i in range(len(schedule.buckets)):
+                s, _ = fusion.reduce_scatter_bucket_compressed(
+                    schedule, i, leaves_g, wire, op=rs_op)
+                shards.append(s)
+            if sharded_tx:
+                grad_rows = {f"b{i}": s[None]
+                             for i, s in enumerate(shards)}
+                updates, opt_state = zero_lib.apply_shards(
+                    tx.inner, grad_rows, state.opt_state, state.params,
+                    wire=wire)
+            else:
+                leaves_p, treedef = jax.tree_util.tree_flatten(
+                    state.params)
+                new_leaves = [None] * len(leaves_p)
+                for i, s in enumerate(shards):
+                    flat, _ = fusion.all_gather_bucket_compressed(
+                        schedule, i, s, wire)
+                    for j, arr in fusion.unpack_bucket(
+                            schedule, i, flat, leaves_p).items():
+                        new_leaves[j] = arr
+                grads_full = jax.tree_util.tree_unflatten(treedef,
+                                                          new_leaves)
+                updates, opt_state = tx.update_preaveraged(
+                    grads_full, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            loss = collective.allreduce(loss, op=collective.Average,
+                                        axes=(batch_axis,))
+            new_state = TrainState(params=params, opt_state=opt_state,
+                                   batch_stats=state.batch_stats,
+                                   step=state.step + 1)
+            return new_state, loss
+
+        def global_step(state, tokens):
+            specs = state_specs(state)
+            island = gspmd_lib.shard_map_island(
+                local_step, plan,
+                in_specs=(specs, token_spec),
+                out_specs=(specs, P()))
+            return island(state, tokens)
+    else:
+        def global_step(state, tokens):
             # the global mean IS the exact loss — no allreduce of
             # per-shard partial means to get right
-            return -jnp.mean(ll)
-
-        loss, grads = jax.value_and_grad(compute_loss)(state.params)
-        updates, opt_state = tx.update_spmd(grads, state.opt_state,
-                                            state.params, plan)
-        params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(params=params, opt_state=opt_state,
-                               batch_stats=state.batch_stats,
-                               step=state.step + 1)
-        return new_state, loss
+            loss, grads = jax.value_and_grad(_local_loss)(state.params,
+                                                          tokens)
+            if wire is not None and not sharded_tx:
+                # plain DP: round-trip through the wire dtype as the
+                # convert-sinking hint (see _make_spmd_train_step)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(wire.wire_dtype).astype(g.dtype)
+                               if jnp.issubdtype(g.dtype, jnp.floating)
+                               else g), grads)
+            if wire is not None and sharded_tx:
+                updates, opt_state = tx.update_spmd(
+                    grads, state.opt_state, state.params, plan,
+                    wire=wire)
+            else:
+                updates, opt_state = tx.update_spmd(
+                    grads, state.opt_state, state.params, plan)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(params=params, opt_state=opt_state,
+                                   batch_stats=state.batch_stats,
+                                   step=state.step + 1)
+            return new_state, loss
 
     place_tokens = _placer(mesh, token_spec)
 
@@ -1000,7 +1344,7 @@ def _make_spmd_lm_train_step(model, tx, mesh=None, batch_axis="data",
 
     prog = _SpmdProgram(plan, global_step, arg_specs=(token_spec,),
                         n_scalar_outs=1, donate=donate)
-    _check_wire_drift = _spmd_wire_drift_checker(tx)
+    _check_wire_drift = _spmd_wire_drift_checker(tx, wire)
 
     from horovod_tpu.diag import recorder as _flightrec
     from horovod_tpu.telemetry import ledger as _ledger_lib

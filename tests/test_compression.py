@@ -403,6 +403,141 @@ def test_ef_residual_changes_compiled_program_only_when_compressed(hvd):
 
 
 # ---------------------------------------------------------------------------
+# the compiled shard_map island (ISSUE 17)
+
+
+def test_spmd_island_quantizer_bitwise_parity(hvd):
+    """The quantizer inside the GSPMD shard_map island is the SAME math
+    as the eager ChunkedQuantizer on the same buckets: the quantized
+    int8 rows that cross the wire must match BITWISE between the
+    compiled island and an eager compress_flat on identical packed
+    rows. The fp32 sidecar (per-chunk scales, decode-sum-average,
+    gather-decode) is pinned to ulp tolerance instead: XLA may fuse the
+    scale divide / decode arithmetic with FMA or a reciprocal multiply,
+    which moves the last bit but nothing else."""
+    from horovod_tpu.parallel import gspmd
+
+    mesh, axes, world = _bowl_mesh(2)
+    plan = gspmd.derive_plan(mesh)
+    rng = np.random.default_rng(5)
+    leaves = [jnp.asarray(rng.normal(size=s) * 10.0, jnp.float32)
+              for s in [(7, 5), (300,), (4, 4)]]
+    schedule = fusion.bucket_schedule(leaves, world=world, axes=axes)
+    wire = Compression.int8
+
+    def island_fn(*ls):
+        encs, shards, flats = [], [], []
+        for i in range(len(schedule.buckets)):
+            shard = schedule.shard_sizes[i]
+            rows = fusion._pack_padded(schedule, i, list(ls)).reshape(
+                world, shard)
+            q = wire.for_length(shard)
+            encs.append(q.compress_flat(rows))
+            s, _ = fusion.reduce_scatter_bucket_compressed(
+                schedule, i, list(ls), wire, op=collective.Average)
+            f, _ = fusion.all_gather_bucket_compressed(
+                schedule, i, s, wire)
+            shards.append(s[None])
+            flats.append(f)
+        return tuple(encs), tuple(shards), tuple(flats)
+
+    fn = gspmd.shard_map_island(
+        island_fn, plan,
+        in_specs=tuple(P() for _ in leaves),
+        out_specs=(tuple((P(), P()) for _ in schedule.buckets),
+                   tuple(P(tuple(axes)) for _ in schedule.buckets),
+                   tuple(P() for _ in schedule.buckets)))
+    got_encs, got_shards, got_flats = jax.jit(fn)(*leaves)
+
+    for i in range(len(schedule.buckets)):
+        shard = schedule.shard_sizes[i]
+        flat = fusion._pack_padded(schedule, i, leaves)
+        rows = flat.reshape(world, shard)
+        q = wire.for_length(shard)
+        wire_rows, scales = q.compress_flat(rows)
+        # the wire payload is bit-identical compiled vs eager
+        np.testing.assert_array_equal(
+            np.asarray(got_encs[i][0]), np.asarray(wire_rows),
+            err_msg=f"bucket {i}: island wire rows != eager quantizer")
+        np.testing.assert_allclose(
+            np.asarray(got_encs[i][1]), np.asarray(scales),
+            rtol=1e-6,
+            err_msg=f"bucket {i}: island scales != eager quantizer")
+        # ...and the decoded data plane matches to the last fused bit
+        exp_shards = []
+        for k in range(world):
+            # every peer contributes the identical encoded row k
+            recv_rows = jnp.stack([wire_rows[k]] * world)
+            recv_scales = jnp.stack([scales[k]] * world)
+            vals = q.decompress_flat(recv_rows, recv_scales,
+                                     jnp.float32, n=shard)
+            exp_shards.append(jnp.sum(vals, axis=0) / world)
+        np.testing.assert_allclose(
+            np.asarray(got_shards[i]), np.stack(exp_shards),
+            rtol=1e-6, atol=1e-5,
+            err_msg=f"bucket {i}: island RS != eager quantizer")
+        enc = [q.compress_flat(s) for s in exp_shards]
+        exp_flat = q.decompress_flat(
+            jnp.stack([e[0] for e in enc]),
+            jnp.stack([e[1] for e in enc]),
+            jnp.float32, n=shard).reshape(world * shard)
+        np.testing.assert_allclose(
+            np.asarray(got_flats[i]), np.asarray(exp_flat),
+            rtol=1e-6, atol=1e-5,
+            err_msg=f"bucket {i}: island AG != eager quantizer")
+
+
+def test_spmd_error_feedback_is_load_bearing_quadratic_bowl(hvd):
+    """The explicit path's EF-is-load-bearing bowl, run through the
+    compiled island (spmd=True): int8+EF lands on the fp32 oracle,
+    int8 without EF measurably stalls — the residual carry threaded
+    through the jit argument is doing real work, not decoration."""
+    mesh, axes, n = _bowl_mesh(2)
+    D = 32
+    rng = np.random.default_rng(3)
+    Q, _ = np.linalg.qr(rng.standard_normal((D, D)))
+    shard_X = Q * np.sqrt(D)  # X^T X = D*I
+    w_true = np.ones(D)
+    w_true[0] = 300.0
+    shard_y = shard_X @ w_true
+    X = jnp.asarray(np.tile(shard_X, (n, 1)), jnp.float32)
+    y = jnp.asarray(np.tile(shard_y, n), jnp.float32)
+    model = MLP(features=(1,))
+
+    def mse(logits, labels):
+        return jnp.mean((logits[:, 0] - labels) ** 2)
+
+    def run(wire, ef):
+        tx = hvd_api.DistributedOptimizer(optax.sgd(0.4), axes=axes,
+                                          compression=wire)
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0), X[:1])
+        step = training.make_train_step(model, tx, mesh=mesh,
+                                        loss_fn=mse, donate=False,
+                                        spmd=True, error_feedback=ef)
+        for _ in range(30):
+            state, loss = step(state, X, y)
+        return float(loss), state.params
+
+    loss_exact, p_exact = run("none", True)
+    loss_ef, p_ef = run("int8", True)
+    loss_noef, p_noef = run("int8", False)
+    assert loss_exact < 1e-6
+
+    def gap(p):
+        return max(float(jnp.max(jnp.abs(a - b))) for a, b in
+                   zip(jax.tree_util.tree_leaves(p),
+                       jax.tree_util.tree_leaves(p_exact)))
+
+    g_ef, g_noef = gap(p_ef), gap(p_noef)
+    assert g_ef < 3e-3, f"EF failed to land on the oracle: gap {g_ef}"
+    assert g_noef > 3e-2, (
+        f"no-EF landed on the oracle (gap {g_noef}) — the island no "
+        "longer exercises the stall, or EF leaked into ef=False")
+    assert g_noef > 10 * g_ef
+
+
+# ---------------------------------------------------------------------------
 # autotune wire axis
 
 

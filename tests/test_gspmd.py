@@ -2,7 +2,9 @@
 collectives. Pins the plan's spec derivation, the spmd train step's
 parity with the explicit overlap+ZeRO pipeline (the dryrun 1b4 contract,
 run here as the tier-1 smoke), the compiled-HLO byte accounting, the
-wire-compression fallback, the compat gate — and the tier-1 GUARD that
+compiled-in-place wire compression (the shard_map island for chunked
+quantizers, dtype-narrowed constraints for casts — ISSUE 17), the
+compat gate — and the tier-1 GUARD that
 keeps the hot path ON the mesh: no new ``pmap(``/``shard_map(`` call
 sites may appear in ``horovod_tpu/`` outside the pinned baseline
 (``compat.py`` and ``parallel/gspmd.py`` excluded as the shim layers)."""
@@ -105,6 +107,15 @@ def test_spmd_step_matches_explicit_overlap_zero1(hvd):
     graft._dryrun_gspmd(jax.devices())
 
 
+def test_spmd_wire_island_matches_exact_gspmd(hvd):
+    """The 1b5 contract (ISSUE 17) as the tier-1 smoke, on a 2-device
+    mesh: GSPMD+int8+EF and GSPMD+fp8+EF 8-step trajectories within
+    WIRE_EPSILON of the exact fp32 GSPMD path, compression-off programs
+    identical, compressed program different."""
+    import __graft_entry__ as graft
+    graft._dryrun_gspmd_wire(jax.devices()[:2])
+
+
 def test_spmd_plain_dp_matches_explicit(hvd):
     """Non-sharded (plain DP) GSPMD: tx.update_spmd routes through the
     preserved optimizer chain, so state stays interchangeable."""
@@ -198,7 +209,7 @@ def test_spmd_step_with_loader(hvd):
         loader.close()
 
 
-# ---- guards and fallbacks ---------------------------------------------
+# ---- guards and wire routing ------------------------------------------
 
 def test_spmd_rejects_explicit_pipeline_knobs(hvd):
     model = MLP(features=(4,))
@@ -211,13 +222,15 @@ def test_spmd_rejects_explicit_pipeline_knobs(hvd):
         training.make_train_step(model, tx_adasum, spmd=True)
 
 
-def test_spmd_wire_compression_falls_back_to_bucketed(hvd):
-    """A wire-compressed optimizer has no annotation-only exchange: the
-    spmd builder must WARN and hand back the explicit bucketed pipeline
-    (docs/PERFORMANCE.md, 'The GSPMD path'), which still trains."""
+def test_spmd_wire_compression_compiles_island_in_place(hvd):
+    """A chunked wire (int8) under spmd=True compiles IN-PLACE as the
+    shard_map island (ISSUE 17) — no fallback warning, the build stays
+    the GSPMD step, it trains, and the island's quantized exchange shows
+    up in the compiled byte accounting as all-to-all traffic."""
     n = len(jax.devices())
-    X = jnp.asarray(np.ones((2 * n, 6)), jnp.float32)
-    y = jnp.asarray(np.zeros((2 * n,)), jnp.int32)
+    rng = np.random.default_rng(3)
+    X = jnp.asarray(rng.normal(size=(2 * n, 6)), jnp.float32)
+    y = jnp.asarray(np.arange(2 * n) % 3, jnp.int32)
     model = MLP(features=(8, 3))
     tx = hvd_api.DistributedOptimizer(optax.sgd(0.05),
                                       sharded_update=True,
@@ -226,13 +239,46 @@ def test_spmd_wire_compression_falls_back_to_bucketed(hvd):
         warnings.simplefilter("always")
         step = training.make_train_step(model, tx, donate=False,
                                         spmd=True)
-    assert any("falling back to the explicit bucketed pipeline"
-               in str(x.message) for x in w)
-    assert not getattr(step, "spmd", False)  # the explicit build
+    assert not any("falling back" in str(x.message) for x in w), (
+        [str(x.message) for x in w])
+    assert step.spmd  # still the GSPMD build
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        X[:1])
+    losses = []
+    for _ in range(3):
+        state, loss = step(state, X, y)
+        losses.append(float(loss))
+    assert all(np.isfinite(v) for v in losses)
+    if n > 1:
+        # the chunked exchange is an alltoall of wire rows + scales —
+        # the honest compiled bytes must include it
+        assert step.compiled_collectives.get("all-to-all", {}).get(
+            "calls", 0) >= 1, step.compiled_collectives
+
+
+def test_spmd_cast_wire_keeps_annotation_program(hvd):
+    """Cast wires (bf16) have an annotation-only form: no island, no
+    fallback — the constraint path carries them and the step trains."""
+    n = len(jax.devices())
+    X = jnp.asarray(np.ones((2 * n, 6)), jnp.float32)
+    y = jnp.asarray(np.zeros((2 * n,), np.int32))
+    model = MLP(features=(8, 3))
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.05),
+                                      sharded_update=True,
+                                      compression="bf16")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step = training.make_train_step(model, tx, donate=False,
+                                        spmd=True)
+    assert not any("falling back" in str(x.message) for x in w)
+    assert step.spmd
     state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
                                         X[:1])
     state, loss = step(state, X, y)
     assert np.isfinite(float(loss))
+    # no shard_map island on the cast path: the program stays pure
+    # annotation — chunked formats are the only island tenants
+    assert step.compiled_collectives.get("all-to-all") is None
 
 
 def test_spmd_step_retraces_on_new_batch_shape(hvd):
@@ -275,7 +321,14 @@ def test_spmd_step_warns_on_late_wire_install(hvd):
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             state, _ = step(state, X, y)
-        assert any("built uncompressed" in str(x.message) for x in w)
+        drift = [str(x.message) for x in w
+                 if "built uncompressed" in str(x.message)]
+        assert drift
+        # ISSUE 17 regression: compression now compiles in-place, so
+        # the remedy is REBUILDING the step — the message must say so
+        # and must not claim a fallback that no longer happens
+        assert any("Rebuild the step" in m for m in drift), drift
+        assert not any("fall" in m.lower() for m in drift), drift
     finally:
         basics._state.config.wire_dtype = old
 
@@ -383,6 +436,94 @@ def test_spmd_step_records_compiled_collectives(hvd):
     # once per compile, not per step
     state, _ = step(state, X, y)
     assert spmd_bytes() == after
+
+
+def test_spmd_island_retrace_keeps_per_program_wire_accounting(hvd):
+    """A second batch shape under the compressed island compiles a
+    second program whose wire bytes are accounted ONCE for that
+    program — re-running an already-compiled shape adds nothing
+    (ISSUE 17: N-shape retrace keeps per-program wire accounting)."""
+    from horovod_tpu import telemetry
+    from horovod_tpu.telemetry import instruments as ti
+
+    n = len(jax.devices())
+    model = MLP(features=(8, 3))
+    tx = hvd_api.DistributedOptimizer(optax.sgd(0.05),
+                                      sharded_update=True,
+                                      compression="int8")
+    step = training.make_train_step(model, tx, donate=False, spmd=True)
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        jnp.ones((1, 6)))
+    X1 = jnp.ones((2 * n, 6)); y1 = jnp.zeros((2 * n,), jnp.int32)
+    X2 = jnp.ones((4 * n, 6)); y2 = jnp.zeros((4 * n,), jnp.int32)
+
+    def spmd_bytes():
+        fam = telemetry.get_registry().get(ti.COLLECTIVE_BYTES)
+        s = fam.sample() if fam is not None else {}
+        if not isinstance(s, dict):
+            return 0.0
+        return sum(v for k, v in s.items()
+                   if any(str(p).startswith("spmd_") for p in k))
+
+    b0 = spmd_bytes()
+    state, _ = step(state, X1, y1)
+    b1 = spmd_bytes()
+    assert b1 > b0  # first program's island bytes recorded
+    state, _ = step(state, X2, y2)
+    b2 = spmd_bytes()
+    assert b2 > b1  # second shape -> second program, its own bytes
+    state, l3 = step(state, X1, y1)  # cached program: no new bytes
+    assert spmd_bytes() == b2
+    assert np.isfinite(float(l3))
+
+
+def test_spmd_zero1_checkpoint_interchangeable_with_explicit(hvd):
+    """ZeRO-1 optimizer state written by the explicit compressed
+    pipeline restores bit-for-bit into the compiled island step and
+    vice versa (ISSUE 17) — same tree structure, same leaf
+    shapes/dtypes, and each path trains on from the other's state."""
+    n = len(jax.devices())
+    rng = np.random.default_rng(11)
+    X = jnp.asarray(rng.normal(size=(2 * n, 6)), jnp.float32)
+    y = jnp.asarray(np.arange(2 * n) % 3, jnp.int32)
+    model = MLP(features=(8, 3))
+
+    def build(spmd):
+        tx = hvd_api.DistributedOptimizer(optax.adam(0.05),
+                                          sharded_update=True,
+                                          compression="int8")
+        step = training.make_train_step(model, tx, donate=False,
+                                        spmd=spmd)
+        state = training.create_train_state(model, tx,
+                                            jax.random.PRNGKey(0), X[:1])
+        return step, state
+
+    exp_step, exp_state = build(spmd=False)
+    spmd_step, spmd_state = build(spmd=True)
+
+    for _ in range(2):
+        exp_state, _ = exp_step(exp_state, X, y)
+        spmd_state, _ = spmd_step(spmd_state, X, y)
+
+    # identical checkpoint payload: same treedef, same leaf shape/dtype
+    e_leaves, e_def = jax.tree_util.tree_flatten(exp_state)
+    s_leaves, s_def = jax.tree_util.tree_flatten(spmd_state)
+    assert e_def == s_def
+    for e, s in zip(e_leaves, s_leaves):
+        assert e.shape == s.shape and e.dtype == s.dtype
+
+    # "save" on one path, "restore" on the other, keep training
+    host = [np.asarray(jax.device_get(v)) for v in e_leaves]
+    restored = jax.tree_util.tree_unflatten(
+        s_def, [jnp.asarray(v) for v in host])
+    restored, loss_s = spmd_step(restored, X, y)
+    assert np.isfinite(float(loss_s))
+
+    host_b = [np.asarray(jax.device_get(v)) for v in s_leaves]
+    restored_b = jax.tree_util.tree_unflatten(
+        e_def, [jnp.asarray(v) for v in host_b])
+    restored_b, loss_e = exp_step(restored_b, X, y)
+    assert np.isfinite(float(loss_e))
 
 
 def test_spmd_state_place_roundtrip(hvd):
