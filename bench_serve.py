@@ -9,6 +9,9 @@ SLO numbers docs/SERVING.md names:
 
 * ``ttft_ms_p50`` / ``ttft_ms_p99``   — time to first token (arrival →
   first streamed token: queueing + prefill),
+* ``ttft_admission_ms_{p50,p99}``     — first token measured from KV
+  **admission** instead of arrival (prefill only; the spread between
+  the two is pure queue/backpressure wait),
 * ``inter_token_ms_p50`` / ``_p99``   — gaps between streamed tokens
   (steady-state decode cadence),
 * ``tokens_per_sec_per_chip``         — generated-token throughput,
@@ -35,6 +38,16 @@ gate end to end (single-replica saturation probe → 2-replica fleet at
 fraction > 0.5, zero requests dropped, and every attribution block
 explains wall clock within tolerance.
 
+Per-request tail attribution (ISSUE 18): every bench run traces every
+request (``serve/tracing.py``, sample=1.0) and reports a
+``tail_attribution`` block — for each request in the p99 latency
+bucket, the fraction of its latency tiled by NAMED spans/gaps must be
+≥ ``TAIL_ATTRIBUTION_BOUND`` (98%), and each slow request's dominant
+stall is classified with the `hvd-doctor serve` tables. Chaos runs
+additionally require the doctor to name ``redispatch_hop`` dominant
+for every cut-and-resumed stream. ``--trace-dir`` dumps the raw
+ndjson + merged Chrome trace for offline `hvd-doctor serve`.
+
 Runs on the 8-device CPU mesh exactly like the rest of the bench suite
 (`JAX_PLATFORMS=cpu python bench_serve.py`); the numbers are CPU-mesh
 numbers — the harness, shapes and invariants are what transfer to TPU.
@@ -47,6 +60,8 @@ import time
 import numpy as np
 
 ATTRIBUTION_TOLERANCE = 0.02  # mirror telemetry/report's goodput bound
+TAIL_ATTRIBUTION_BOUND = 0.98  # named-span coverage of every p99-bucket
+                               # request's latency (ISSUE 18)
 
 
 def build_parser():
@@ -80,6 +95,10 @@ def build_parser():
     p.add_argument("--acceptance", action="store_true",
                    help="run the ISSUE-16 acceptance recipe (saturation "
                         "probe -> 2-replica fleet at 2x -> chaos soak)")
+    p.add_argument("--trace-dir", default=None,
+                   help="dump the per-request traces here "
+                        "(servetrace.ndjson for `hvd-doctor serve` + "
+                        "a merged Chrome trace)")
     p.add_argument("--json", default=None,
                    help="also write the result block to this path")
     return p
@@ -142,13 +161,74 @@ def _cached_fraction(engines):
     return (cached / prompt) if prompt else 0.0
 
 
+def _tail_attribution(tracer, chaos=False):
+    """The ISSUE-18 gate block: every p99-bucket request's latency must
+    be ≥ TAIL_ATTRIBUTION_BOUND tiled by named spans/gaps, and (chaos
+    runs) the doctor must name redispatch_hop dominant for every
+    cut-and-resumed stream."""
+    from horovod_tpu.diag import serve_doctor
+
+    per = []
+    for tr in tracer.traces():
+        totals = serve_doctor.phase_totals(tr)
+        dom, _ = serve_doctor.dominant_stall(totals)
+        per.append({"request_id": tr["request_id"],
+                    "latency_ms": tr["latency_s"] * 1e3,
+                    "attributed_fraction": tr["attributed_fraction"],
+                    "hops": int(tr.get("hops", 0)),
+                    "dominant_stall": dom})
+    if not per:
+        return {"traced": 0, "valid": False}
+    p99 = float(np.percentile([r["latency_ms"] for r in per], 99))
+    bucket = [r for r in per if r["latency_ms"] >= p99]
+    min_attr = min(r["attributed_fraction"] for r in bucket)
+    stalls = {}
+    for r in bucket:
+        stalls[r["dominant_stall"]] = \
+            stalls.get(r["dominant_stall"], 0) + 1
+    block = {
+        "traced": len(per),
+        "min_attributed_fraction": round(
+            min(r["attributed_fraction"] for r in per), 4),
+        "p99_ms": round(p99, 3),
+        "p99_bucket": len(bucket),
+        "p99_bucket_min_attributed_fraction": round(min_attr, 4),
+        "p99_dominant_stalls": dict(sorted(stalls.items())),
+        "valid": min_attr >= TAIL_ATTRIBUTION_BOUND,
+    }
+    if chaos:
+        # vacuously true when the drain finished everything in grace
+        # (no streams were cut — the graceful path, also a success);
+        # when streams WERE cut, each one's dominant stall must be the
+        # hop the eviction caused
+        hopped = [r for r in per if r["hops"]]
+        block["cut_streams"] = len(hopped)
+        block["cut_streams_redispatch_dominant"] = all(
+            r["dominant_stall"] == "redispatch_hop" for r in hopped)
+        block["valid"] = (block["valid"]
+                          and block["cut_streams_redispatch_dominant"])
+    return block
+
+
+def _dump_traces(tracer, trace_dir):
+    if not trace_dir or not tracer.traces():
+        return
+    import os
+    os.makedirs(trace_dir, exist_ok=True)
+    tracer.write_ndjson(os.path.join(trace_dir, "servetrace.ndjson"))
+    tracer.write_chrome(os.path.join(trace_dir,
+                                     "servetrace.merged.json"))
+
+
 def run_bench(args):
-    from horovod_tpu.serve import Request, ServeEngine
+    from horovod_tpu.serve import Request, ServeEngine, ServeTracer
 
     rng, model, params, kv, mesh, n_chips, prompts = _setup(args)
+    tracer = ServeTracer(sample=1.0)  # every request: the tail gate
     engine = ServeEngine(model, params, kv, mesh=mesh,
                          max_slots=args.max_slots,
-                         prefill_chunk=args.prefill_chunk)
+                         prefill_chunk=args.prefill_chunk,
+                         tracer=tracer)
 
     requests = [Request(p, args.max_new) for p in prompts]
 
@@ -163,6 +243,7 @@ def run_bench(args):
         engine.time_breakdown[k] = 0.0
     engine.prompt_tokens = 0
     engine.cached_prefill_tokens = 0
+    tracer.clear()  # the warm request's trace is compile time, not load
 
     # open loop: arrival i at t0 + i/rate, submitted when its time comes
     # whether or not the engine kept up
@@ -188,6 +269,8 @@ def run_bench(args):
             f"{len(failed)} bench request(s) failed: {failed[0].error}")
 
     ttft = [r.first_token_time - r.arrival for r in requests]
+    ttft_adm = [r.first_token_time - r.admitted_at for r in requests
+                if r.admitted_at is not None]
     itl = [b - a for r in requests
            for a, b in zip(r.token_times, r.token_times[1:])]
     total_tokens = sum(len(r.generated) for r in requests)
@@ -219,6 +302,7 @@ def run_bench(args):
         "kv_pool_blocks": kv.num_blocks,
         "kv_pool_mib": round(kv.pool_bytes() / 2 ** 20, 2),
         "ttft_ms": _percentiles_ms(ttft),
+        "ttft_admission_ms": _percentiles_ms(ttft_adm),
         "inter_token_ms": _percentiles_ms(itl),
         "tokens_generated": total_tokens,
         "tokens_per_sec": round(total_tokens / wall_s, 2),
@@ -226,7 +310,9 @@ def run_bench(args):
                                          3),
         "cached_prefill_fraction": round(_cached_fraction([engine]), 4),
         "attribution": attribution,
+        "tail_attribution": _tail_attribution(tracer),
     }
+    _dump_traces(tracer, args.trace_dir)
     return result
 
 
@@ -240,7 +326,7 @@ def run_fleet_bench(args):
     import jax
 
     from horovod_tpu.parallel import mesh as mesh_lib
-    from horovod_tpu.serve import ServeEngine
+    from horovod_tpu.serve import ServeEngine, ServeTracer
     from horovod_tpu.serve.fleet import FleetRouter
 
     rng, model, params, kv, mesh, n_chips, prompts = _setup(args)
@@ -260,7 +346,11 @@ def run_fleet_bench(args):
                            prefill_chunk=args.prefill_chunk,
                            name=f"r{i}")
                for i in range(args.fleet)]
-    router = FleetRouter(grace=args.grace)
+    # the router owns fleet traces whole-life; engines see the SAME
+    # RequestTrace riding each per-hop engine request, so a cut
+    # stream's spans land in one trace across replicas
+    tracer = ServeTracer(sample=1.0)
+    router = FleetRouter(grace=args.grace, tracer=tracer)
     for i, eng in enumerate(engines):
         router.add_replica(f"r{i}", eng, env={})
     router.start()
@@ -273,6 +363,7 @@ def run_fleet_bench(args):
     for eng in engines:
         eng.prompt_tokens = 0
         eng.cached_prefill_tokens = 0
+    tracer.clear()  # drop the warm requests' traces
 
     chaos_index = (None if args.chaos_at is None
                    else max(1, int(args.chaos_at * args.requests)))
@@ -306,6 +397,8 @@ def run_fleet_bench(args):
                            f"{failed[0].error}")
 
     ttft = [r.first_token_time - r.arrival for r in reqs]
+    ttft_adm = [r.first_token_time - r.admitted_at for r in reqs
+                if r.admitted_at is not None]
     itl = [b - a for r in reqs
            for a, b in zip(r.token_times, r.token_times[1:])]
     total_tokens = sum(len(r.generated) for r in reqs)
@@ -348,6 +441,7 @@ def run_fleet_bench(args):
         "shared_prefix": args.shared_prefix,
         "chaos_at": args.chaos_at,
         "ttft_ms": _percentiles_ms(ttft),
+        "ttft_admission_ms": _percentiles_ms(ttft_adm),
         "inter_token_ms": _percentiles_ms(itl),
         "tokens_generated": total_tokens,
         "tokens_per_sec": round(total_tokens / wall_s, 2),
@@ -355,8 +449,11 @@ def run_fleet_bench(args):
         "redispatched": router.redispatched,
         "dropped": router.dropped,
         "attribution": attribution,
+        "tail_attribution": _tail_attribution(
+            tracer, chaos=args.chaos_at is not None),
     }
     router.stop()
+    _dump_traces(tracer, args.trace_dir)
     return result
 
 
@@ -397,6 +494,12 @@ def run_acceptance(args):
         "attribution_valid": (single["attribution"]["valid"]
                               and fleet["attribution"]["valid"]
                               and chaos["attribution"]["valid"]),
+        # ISSUE 18: ≥98% of every p99-bucket request's latency named,
+        # and the doctor blames redispatch_hop for every cut stream
+        "tail_attribution_valid": (
+            single["tail_attribution"]["valid"]
+            and fleet["tail_attribution"]["valid"]
+            and chaos["tail_attribution"]["valid"]),
     }
     return {
         "mode": "serve_fleet_acceptance",
@@ -418,22 +521,36 @@ def main(argv=None):
         ok = result["passed"]
     elif args.fleet:
         result = run_fleet_bench(args)
-        ok = result["attribution"]["valid"]
+        ok = (result["attribution"]["valid"]
+              and result["tail_attribution"]["valid"])
     else:
         result = run_bench(args)
-        ok = result["attribution"]["valid"]
+        ok = (result["attribution"]["valid"]
+              and result["tail_attribution"]["valid"])
     print(json.dumps(result, indent=1))
     if not ok:
         if args.acceptance:
             bad = [k for k, v in result["checks"].items() if not v]
             print(f"SERVE FLEET ACCEPTANCE FAILED: {', '.join(bad)}")
-        else:
+        elif not result["attribution"]["valid"]:
             explained = 1 - abs(
                 result["attribution"]["unattributed_fraction"])
             print("SERVE ATTRIBUTION VIOLATED: engine phases + idle "
                   f"explain {explained:.1%} of wall clock (tolerance "
                   f"{ATTRIBUTION_TOLERANCE:.0%}) — a scheduler phase is "
                   "leaking unaccounted time")
+        else:
+            ta = result["tail_attribution"]
+            print("SERVE TAIL ATTRIBUTION VIOLATED: a p99-bucket "
+                  "request has only "
+                  f"{ta.get('p99_bucket_min_attributed_fraction', 0):.1%}"
+                  " of its latency named by trace spans (bound "
+                  f"{TAIL_ATTRIBUTION_BOUND:.0%})"
+                  + ("" if ta.get("cut_streams_redispatch_dominant",
+                                  True)
+                     else " — and the doctor does not name "
+                          "redispatch_hop dominant for every cut "
+                          "stream"))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(result, f, indent=1)

@@ -13,6 +13,15 @@ from the AST, no imports — the pass must run without jax installed):
    whose name is a string literal not in CATALOGUE, flagged at the use
    site — the drift the old guard only caught if the author also
    remembered to touch the docs.
+
+Plus the serving-trace twin of the same contract: every span kind in
+``serve/tracing.py``'s SPAN_KINDS must have an entry in the serve
+doctor's PHASE_OF_KIND classifier (``diag/serve_doctor.py``) and vice
+versa — a kind the tracer emits but the doctor cannot classify lands
+in the slow-request report as dead weight, and a classifier entry for
+a kind the tracer never emits is documentation rot. Both directions
+are flagged; the sub-check is skipped when either file is absent from
+the parsed tree (partial-tree runs).
 """
 
 import ast
@@ -23,6 +32,8 @@ from horovod_tpu.analysis import engine
 from horovod_tpu.analysis.rules import common
 
 _INSTRUMENTS_SUFFIX = "telemetry/instruments.py"
+_TRACING_SUFFIX = "serve/tracing.py"
+_SERVE_DOCTOR_SUFFIX = "diag/serve_doctor.py"
 _DOC = "docs/OBSERVABILITY.md"  # forward-slash: baseline/finding key
 _DOC_ROW = re.compile(r"^\|\s*`(hvd_[a-z0-9_]+)`\s*\|")
 _REGISTER_CALLS = frozenset({"counter", "gauge", "histogram"})
@@ -66,6 +77,87 @@ def _find_instruments(parsed):
                 None)
 
 
+def _find_suffix(parsed, suffix):
+    return next((pf for rel, pf in sorted(parsed.items())
+                 if rel.replace("\\", "/").endswith(suffix)), None)
+
+
+def _tuple_of_strings(pf, target):
+    """(values, lineno) of a module-level ``TARGET = ("a", "b", ...)``
+    assignment, or (None, 1) when absent/unparseable."""
+    for node in pf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == target and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            vals = [el.value for el in node.value.elts
+                    if isinstance(el, ast.Constant) and
+                    isinstance(el.value, str)]
+            return vals, node.lineno
+    return None, 1
+
+
+def _dict_string_keys(pf, target):
+    """(keys, lineno) of a module-level ``TARGET = {"a": ..., ...}``
+    assignment, or (None, 1) when absent/unparseable."""
+    for node in pf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == target and \
+                isinstance(node.value, ast.Dict):
+            keys = [k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant) and
+                    isinstance(k.value, str)]
+            return keys, node.lineno
+    return None, 1
+
+
+def _span_table_findings(parsed):
+    """SPAN_KINDS (serve/tracing.py) ↔ PHASE_OF_KIND
+    (diag/serve_doctor.py) two-way drift."""
+    tracing = _find_suffix(parsed, _TRACING_SUFFIX)
+    doctor = _find_suffix(parsed, _SERVE_DOCTOR_SUFFIX)
+    if tracing is None or doctor is None:
+        return []  # partial-tree run: contract not checkable
+    kinds, kinds_line = _tuple_of_strings(tracing, "SPAN_KINDS")
+    phases, phases_line = _dict_string_keys(doctor, "PHASE_OF_KIND")
+    if kinds is None or phases is None:
+        pf = tracing if kinds is None else doctor
+        line = kinds_line if kinds is None else phases_line
+        missing = "SPAN_KINDS" if kinds is None else "PHASE_OF_KIND"
+        return [engine.Finding(
+            rule="HVD-METRIC", file=pf.rel, line=line, col=1,
+            message=f"could not parse {missing} as a module-level "
+                    "string table",
+            hint="keep the span table a literal tuple/dict so the "
+                 "drift check can read it without imports",
+            fingerprint=f"span-table:{missing}")]
+    findings = []
+    for kind in kinds:
+        if kind not in phases:
+            findings.append(engine.Finding(
+                rule="HVD-METRIC", file=tracing.rel, line=kinds_line,
+                col=1,
+                message=f"span kind `{kind}` has no entry in the serve "
+                        "doctor's PHASE_OF_KIND classifier",
+                hint="hvd-doctor serve must name a phase for every "
+                     "kind the tracer can emit — add the mapping in "
+                     "diag/serve_doctor.py",
+                fingerprint=f"SPAN_KINDS:{kind}"))
+    for kind in phases:
+        if kind not in kinds:
+            findings.append(engine.Finding(
+                rule="HVD-METRIC", file=doctor.rel, line=phases_line,
+                col=1,
+                message=f"PHASE_OF_KIND classifies span kind `{kind}` "
+                        "that serve/tracing.py never emits",
+                hint="drop the ghost entry or add the kind to "
+                     "SPAN_KINDS — the classifier mirrors the span "
+                     "table exactly, both ways",
+                fingerprint=f"PHASE_OF_KIND:{kind}"))
+    return findings
+
+
 def _doc_path(root):
     return os.path.join(root, *_DOC.split("/"))
 
@@ -87,7 +179,8 @@ def _scope_files(parsed, root):
 def check(parsed, root):
     inst = _find_instruments(parsed)
     if inst is None:
-        return []  # partial-tree run: nothing to check against
+        # the span-table contract is independent of instruments.py
+        return _span_table_findings(parsed)
     catalogue, cat_line, legacy = _catalogue(inst)
     if not catalogue:
         return [engine.Finding(
@@ -159,4 +252,7 @@ def check(parsed, root):
                      "family — uncatalogued names dodge the drift "
                      "contract",
                 fingerprint=common.fingerprint(pf, node.lineno)))
+
+    # 4: the serving span-table twin of the same two-way contract
+    findings.extend(_span_table_findings(parsed))
     return findings

@@ -411,11 +411,16 @@ def doctor_cli(argv=None):
     """The ``hvd-doctor`` entry point: ``hvd-doctor [hang] <logdir>``
     runs this module's hang/crash report; ``hvd-doctor perf <logdir>``
     runs the goodput time-attribution report
-    (``horovod_tpu.telemetry.report``) over the same dump directory."""
+    (``horovod_tpu.telemetry.report``); ``hvd-doctor serve <dir>``
+    runs the serving tail-latency report over per-request trace dumps
+    (``horovod_tpu.diag.serve_doctor``)."""
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "perf":
         from horovod_tpu.telemetry import report
         return report.main(argv[1:])
+    if argv and argv[0] == "serve":
+        from horovod_tpu.diag import serve_doctor
+        return serve_doctor.main(argv[1:])
     if argv and argv[0] == "hang":
         argv = argv[1:]
     return main(argv)

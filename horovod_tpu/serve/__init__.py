@@ -28,7 +28,11 @@ what already exists rather than duplicating it:
 * **fleet** (``fleet/``) — N engine replicas behind one routing
   frontend: queue-depth/KV-headroom dispatch, rolling weight reload,
   and spot-preemption drains that re-dispatch cut-off streams to a
-  survivor with zero dropped requests.
+  survivor with zero dropped requests;
+* **tracing** (``tracing.py``) — request-scoped span recording across
+  router, engines and frontends: sampling-controlled, zero-cost when
+  off, exported as ndjson for ``hvd-doctor serve`` and as merged
+  Chrome traces (docs/OBSERVABILITY.md, "Debugging a slow request").
 
 ``bench_serve.py`` (repo root) is the load harness: p50/p99
 time-to-first-token, inter-token latency, tokens/sec/chip under an
@@ -63,6 +67,11 @@ from horovod_tpu.serve.sampling import (  # noqa: F401
     SamplingParams,
 )
 from horovod_tpu.serve.server import ServeServer  # noqa: F401
+from horovod_tpu.serve.tracing import (  # noqa: F401
+    SPAN_KINDS,
+    RequestTrace,
+    ServeTracer,
+)
 
 __all__ = [
     "ServeEngine", "Request", "RequestError",
@@ -70,4 +79,5 @@ __all__ = [
     "load_params", "abstract_params", "ReloadWatcher",
     "ServeServer", "SamplingParams", "GREEDY",
     "Replica", "FleetRouter", "FleetRequest", "FleetServer",
+    "ServeTracer", "RequestTrace", "SPAN_KINDS",
 ]

@@ -75,6 +75,13 @@ def build_parser():
                         "seconds (default: HOROVOD_GRACE_SECONDS); "
                         "notice sources come from the standard "
                         "HOROVOD_PREEMPT_NOTICE_FILE/_URL env knobs")
+    p.add_argument("--trace-dir", default=None,
+                   help="write per-request trace dumps here on "
+                        "shutdown (ndjson for `hvd-doctor serve` plus "
+                        "a merged Chrome trace); also arms tracing as "
+                        "if HOROVOD_SERVE_TRACE_DIR were set — "
+                        "sampling/SLO come from HOROVOD_SERVE_TRACE "
+                        "and HOROVOD_SERVE_TRACE_SLO_MS")
     return p
 
 
@@ -113,6 +120,7 @@ def main(argv=None):
     from horovod_tpu.serve import kvcache, loader
     from horovod_tpu.serve.fleet import FleetRouter, FleetServer
     from horovod_tpu.serve.server import ServeServer
+    from horovod_tpu.serve.tracing import ServeTracer
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     cfg = TransformerConfig(
@@ -139,6 +147,14 @@ def main(argv=None):
     logger.info("hvd-serve: KV pool %d blocks x %d tokens (%.1f MiB)",
                 num_blocks, args.block_size, kv.pool_bytes() / 2 ** 20)
 
+    # tracing is opt-in (env knobs / --trace-dir); tracer=None keeps
+    # the request path byte-identical to an untraced build
+    tracer = ServeTracer.from_env(out_dir=args.trace_dir)
+    if tracer is not None:
+        logger.info("hvd-serve: request tracing armed (sample=%.3g, "
+                    "slo_ms=%s, dir=%s)", tracer.sample, tracer.slo_ms,
+                    tracer.out_dir)
+
     devs = jax.devices()
     router = None
     if args.fleet > 1:
@@ -153,7 +169,7 @@ def main(argv=None):
         per = len(devs) // args.fleet
         notice_file = os.environ.get(preempt_lib.NOTICE_FILE_ENV)
         notice_url = os.environ.get(preempt_lib.NOTICE_URL_ENV)
-        router = FleetRouter(grace=args.grace)
+        router = FleetRouter(grace=args.grace, tracer=tracer)
         engines = []
         for i in range(args.fleet):
             sub = mesh_lib.build_mesh(devs[i * per:(i + 1) * per])
@@ -171,7 +187,8 @@ def main(argv=None):
         mesh = mesh_lib.build_mesh(devs)
         eng = engine_lib.ServeEngine(
             model, params, kv, mesh=mesh, max_slots=args.max_slots,
-            prefill_chunk=args.prefill_chunk, weights_version=step)
+            prefill_chunk=args.prefill_chunk, weights_version=step,
+            tracer=tracer)
         eng.start()
         target_for_reload, frontend = eng, ServeServer(
             eng, addr=args.addr, port=args.port)
@@ -208,6 +225,16 @@ def main(argv=None):
             router.stop()  # stops every replica engine
         else:
             eng.stop()
+        if tracer is not None and tracer.out_dir:
+            n = len(tracer.traces())
+            if n:
+                merged = os.path.join(tracer.out_dir,
+                                      "servetrace.merged.json")
+                tracer.write_chrome(merged)
+                logger.info("hvd-serve: wrote %d request trace(s) to "
+                            "%s (ndjson) and %s (Chrome)", n,
+                            tracer.out_dir, merged)
+            tracer.close()
     return 0
 
 

@@ -89,7 +89,7 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, tokens, max_new_tokens, eos_id=None,
-                 request_id=None, sampling=None):
+                 request_id=None, sampling=None, trace=False):
         self.id = (next(self._ids) if request_id is None
                    else request_id)
         self.prompt = [int(t) for t in tokens]
@@ -106,8 +106,17 @@ class Request:
         self.prefilled = 0  # prompt tokens whose KV is in the pool
         self.cached_prompt_tokens = 0  # of those, served by prefix cache
         self.arrival = None
+        self.admitted_at = None  # KV reservation granted (TTFT base 2)
         self.first_token_time = None
         self.token_times = []
+        # request-scoped tracing (serve/tracing.py): ``trace`` is the
+        # per-request force flag; ``trace`` the attached RequestTrace
+        # (the router pre-attaches one for fleet requests). The engine
+        # finishes only traces it began itself (_trace_owned).
+        self.trace_requested = bool(trace)
+        self.trace = None
+        self._trace_owned = False
+        self._trace_live = False  # counted in the engine's live total
         self._events = queue.Queue()
 
     def _emit(self, kind, value=None):
@@ -164,7 +173,7 @@ class ServeEngine:
     def __init__(self, model, params, kv_config, mesh=None, max_slots=4,
                  prefill_chunk=16, clock=time.monotonic, registry=None,
                  weights_version=None, prefix_caching=True,
-                 name="default"):
+                 name="default", tracer=None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if prefill_chunk < 1:
@@ -218,6 +227,15 @@ class ServeEngine:
         self._slots = [None] * self.max_slots
         self._waiting = deque()
         self.draining = False  # refusing admission (drain / staging)
+
+        # request tracing (serve/tracing.py). _live_traces is the hot-
+        # path gate: with no traced request in flight the per-iteration
+        # cost of tracing is one int comparison, and with tracer=None
+        # (the default) no request ever records — dispatch behavior and
+        # compiled programs are byte-identical either way (tracing is
+        # pure host bookkeeping; tests assert this).
+        self._tracer = tracer
+        self._live_traces = 0
 
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
@@ -354,6 +372,18 @@ class ServeEngine:
                 self._fail(request, err)
                 raise RequestError(err)
             request.state = "queued"
+            tr = request.trace  # router-attached (fleet requests)
+            if tr is None and self._tracer is not None:
+                tr = self._tracer.begin(request.id,
+                                        force=request.trace_requested)
+                if tr is not None:
+                    request.trace = tr
+                    request._trace_owned = True
+            if tr is not None:
+                request._trace_live = True
+                self._live_traces += 1
+                tr.phase(request.arrival, "queued")
+                tr.event("submit", request.arrival, actor=self.name)
             self._waiting.append(request)
             self.instruments.submitted.inc()
             self.instruments.queue_depth.set(len(self._waiting))
@@ -382,7 +412,14 @@ class ServeEngine:
         preempt-drain and weight-staging window ``/healthz`` reports
         as 503 ``draining`` (docs/SERVING.md, "Spot-drain runbook")."""
         with self._work:
+            changed = self.draining != bool(flag)
             self.draining = bool(flag)
+            if changed and self._live_traces:
+                now = self._clock()
+                for r in list(self._slots) + list(self._waiting):
+                    if r is not None and r.trace is not None:
+                        r.trace.event("drain", now, actor=self.name,
+                                      on=self.draining)
             self._work.notify_all()
 
     # -- rolling weight reload ----------------------------------------------
@@ -423,7 +460,20 @@ class ServeEngine:
             # may dispatch donates the pool exactly like the two
             # programs below
             with self._lock:
-                swapped = self._apply_staged_weights()
+                swapped = False
+                if self._staged is not None:
+                    t_sw = self._clock()
+                    swapped = self._apply_staged_weights()
+                    t_sw_end = self._clock()
+                    self.instruments.weight_swap_seconds.observe(
+                        t_sw_end - t_sw)
+                    if self._live_traces:
+                        for r in self._slots:
+                            if r is not None and r.trace is not None:
+                                r.trace.span(
+                                    "weight_swap", t_sw, t_sw_end,
+                                    actor=self.name,
+                                    version=self.weights_version)
                 admitted = self._admit()
                 prefill_req = min(
                     (r for r in self._slots
@@ -508,11 +558,16 @@ class ServeEngine:
                 # cache-held blocks are reclaimable memory: drop LRU
                 # entries until the reservation fits (live sequences'
                 # refs — and the pin above — keep their blocks safe)
-                self.prefix_cache.release(n_fresh)
+                dropped = self.prefix_cache.release(n_fresh)
                 blocks = self.allocator.alloc(n_fresh)
+                if dropped and req.trace is not None:
+                    req.trace.event("cache_evict", self._clock(),
+                                    actor=self.name, entries=dropped)
             if blocks is None:
                 if shared:
                     self.allocator.free(shared)  # drop the pin
+                if req.trace is not None:
+                    req.trace.phase(self._clock(), "kv_wait")
                 break  # FIFO head backpressured on KV blocks
             if cow:
                 fork = blocks[0]
@@ -528,6 +583,13 @@ class ServeEngine:
             req.state = "prefill"
             req.prefilled = cached_len
             req.cached_prompt_tokens = cached_len
+            now = self._clock()
+            req.admitted_at = now
+            if req.trace is not None:
+                req.trace.phase(now, "prefilling")
+                req.trace.event("admitted", now, actor=self.name,
+                                cached_tokens=cached_len,
+                                blocks=len(seq_blocks), cow=cow)
             self._slots[free] = req
             row = np.zeros((self._kv.max_blocks_per_seq,), np.int32)
             row[:len(seq_blocks)] = seq_blocks
@@ -553,6 +615,8 @@ class ServeEngine:
         chunk = req.prompt[start:start + c]
         tokens = np.zeros((1, c), np.int32)
         tokens[0, :len(chunk)] = chunk
+        tr = req.trace
+        t0 = self._clock() if tr is not None else 0.0
         nxt, self._pool = self._prefill(
             self._params, self._pool, self._place_rep(tokens),
             self._place_rep(np.int32(start)),
@@ -563,10 +627,17 @@ class ServeEngine:
             self._place_rep(self._top_ps[req.slot]))
         req.prefilled = min(start + c, len(req.prompt))
         self._lengths[req.slot] = req.prefilled
-        if req.prefilled >= len(req.prompt):
-            # final chunk: the last prompt token's logits yield the
-            # first generated token — TTFT stops here
-            tok = int(jax.device_get(nxt))
+        final = req.prefilled >= len(req.prompt)
+        # final chunk: the last prompt token's logits yield the
+        # first generated token — TTFT stops here
+        tok = int(jax.device_get(nxt)) if final else None
+        if tr is not None:
+            # recorded here, BEFORE _append_token can retire the
+            # request and finish the trace — else the final compute
+            # span would be lost
+            tr.span("prefill", t0, self._clock(), actor=self.name,
+                    chunk=[start, req.prefilled])
+        if final:
             req.state = "decode"
             self._last_token[req.slot] = tok
             if self.prefix_cache is not None:
@@ -579,12 +650,17 @@ class ServeEngine:
                             req.prompt,
                             [int(b) for b in
                              self._tables[req.slot][:n_full]])
-            self._append_token(req, tok, self._clock())
+            now = self._clock()
+            if tr is not None:
+                tr.phase(now, "decoding")
+            self._append_token(req, tok, now)
 
     def _decode_step(self, decoding):
         active = np.zeros((self.max_slots,), bool)
         active[decoding] = True
         lengths = np.where(active, self._lengths, 0).astype(np.int32)
+        traced = self._live_traces > 0  # the one hot-path check
+        t0 = self._clock() if traced else 0.0
         nxt, self._pool = self._decode(
             self._params, self._pool,
             self._place_batch(self._last_token),
@@ -595,6 +671,14 @@ class ServeEngine:
             self._place_batch(self._top_ps))
         nxt = np.asarray(jax.device_get(nxt))
         now = self._clock()
+        if traced:
+            # before the append loop — _append_token may retire a
+            # request and finish its trace
+            for i in decoding:
+                tr = self._slots[i].trace
+                if tr is not None:
+                    tr.span("decode", t0, now, actor=self.name,
+                            batch=len(decoding))
         for i in decoding:
             req = self._slots[i]
             self._lengths[i] += 1  # the fed token's KV is now cached
@@ -608,6 +692,12 @@ class ServeEngine:
         if req.first_token_time is None:
             req.first_token_time = now
             self.instruments.ttft_seconds.observe(now - req.arrival)
+            if req.admitted_at is not None:
+                # second TTFT base: admission -> first token isolates
+                # prefill; the arrival-based histogram above folds
+                # queue wait in (docs/OBSERVABILITY.md)
+                self.instruments.ttft_admission_seconds.observe(
+                    now - req.admitted_at)
         else:
             self.instruments.inter_token_seconds.observe(
                 now - req.token_times[-2])
@@ -634,6 +724,7 @@ class ServeEngine:
             self.instruments.completed.inc()
             self.instruments.kv_blocks.set(self.allocator.in_use)
             req._emit("done")
+            self._finish_trace(req, "done", reason=reason)
             self._work.notify_all()  # blocks freed: admission may proceed
 
     def _fail(self, req, message):
@@ -641,6 +732,26 @@ class ServeEngine:
         req.error = message
         self.instruments.failed.inc()
         req._emit("error", message)
+        self._finish_trace(req, "failed", error=message)
+
+    def _finish_trace(self, req, outcome, **attrs):
+        """Close out a request's trace participation. Only a counted
+        request decrements the live total (a submit-validation failure
+        never incremented), and only a trace this engine began is
+        finished here — the router finishes fleet-owned traces (a
+        retryable failure is a hop, not the end of the request)."""
+        tr = req.trace
+        if tr is None:
+            return
+        now = self._clock()
+        tr.event(outcome, now, actor=self.name, **attrs)
+        if req._trace_live:
+            req._trace_live = False
+            self._live_traces = max(0, self._live_traces - 1)
+        if req._trace_owned:
+            req._trace_owned = False
+            if self._tracer is not None:
+                self._tracer.finish(tr, end=now)
 
     # -- run loop -------------------------------------------------------------
     @property

@@ -108,7 +108,8 @@ class FleetServer(HttpService):
                                 seed=int(body.get("seed", 0)))
                         freq = FleetRequest(
                             tokens, int(body.get("max_new_tokens", 16)),
-                            eos_id=body.get("eos_id"), sampling=sp)
+                            eos_id=body.get("eos_id"), sampling=sp,
+                            trace=bool(body.get("trace", False)))
                     except (KeyError, ValueError, TypeError) as e:
                         return self._respond_json(400, {"error": str(e)})
                     try:
@@ -137,10 +138,21 @@ class FleetServer(HttpService):
                     self.wfile.write((json.dumps(obj) + "\n").encode())
                     self.wfile.flush()
 
+                tr = freq.trace
+                first = tr is not None
                 try:
                     for tok in freq.stream(
                             timeout=server._stream_timeout):
-                        line({"token": tok})
+                        if first:
+                            # best-effort first-byte span (the trace
+                            # may finalize before the stream drains)
+                            first = False
+                            t0 = tr.now()
+                            line({"token": tok})
+                            tr.span("stream", t0, tr.now(),
+                                    actor="http", first_byte=True)
+                        else:
+                            line({"token": tok})
                     line({"done": True, "tokens": freq.generated,
                           "finish_reason": freq.finish_reason,
                           "hops": freq.hops})

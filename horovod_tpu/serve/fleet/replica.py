@@ -53,6 +53,10 @@ class Replica:
         self.engine = engine
         self.state = READY
         self.stopped_at = None  # clock() when the engine was stopped
+        # clock() when the last drain (preempt, chaos, weight staging)
+        # began — the start of the window request traces overlap and
+        # hvd_serve_weight_swap_seconds measures for a rolling reload
+        self.drain_started_at = None
         self._clock = clock
         self._handler = None
 
@@ -89,6 +93,7 @@ class Replica:
                                     if eng.prefix_cache is not None
                                     else 0),
             "weights_version": eng.weights_version,
+            "drain_started_at": self.drain_started_at,
         }
 
     # -- spot preemption -----------------------------------------------------

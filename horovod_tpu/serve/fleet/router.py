@@ -68,7 +68,7 @@ class FleetRequest:
     _ids = itertools.count()
 
     def __init__(self, tokens, max_new_tokens, eos_id=None,
-                 sampling=None, request_id=None):
+                 sampling=None, request_id=None, trace=False):
         self.id = (f"fleet-{next(self._ids)}" if request_id is None
                    else request_id)
         self.prompt = [int(t) for t in tokens]
@@ -88,8 +88,16 @@ class FleetRequest:
         # the ROUTER's clock (installed at submit) so fake-clock tests
         # and benches see one time base fleet-wide.
         self.arrival = None
+        self.admitted_at = None  # first engine admission (TTFT base 2)
         self.first_token_time = None
         self.token_times = []
+        # request-scoped tracing (serve/tracing.py): the router owns a
+        # fleet request's trace for its WHOLE life — the same
+        # RequestTrace rides every per-hop engine request, so a cut and
+        # its continuation land on one timeline
+        self.trace_requested = bool(trace)
+        self.trace = None
+        self._trace_owned = False
         self._clock = time.monotonic
         self._events = queue.Queue()
 
@@ -132,10 +140,11 @@ class FleetRouter:
 
     def __init__(self, registry=None, clock=time.monotonic,
                  grace=None, stream_timeout=120.0,
-                 stage_timeout=30.0):
+                 stage_timeout=30.0, tracer=None):
         self.registry = registry if registry is not None \
             else get_registry()
         self._clock = clock
+        self._tracer = tracer
         self._grace = grace
         self._stream_timeout = float(stream_timeout)
         self._stage_timeout = float(stage_timeout)
@@ -151,6 +160,10 @@ class FleetRouter:
             instruments_lib.SERVE_REQUESTS,
             "Generate requests by lifecycle event (submitted / "
             "completed / failed)", label_names=("event",))
+        self._redispatch_counter = \
+            instruments_lib.serve_redispatch_counter(self.registry)
+        self._swap_seconds = \
+            instruments_lib.serve_weight_swap_histogram(self.registry)
         self.redispatched = 0  # request hops survived (not failures)
         self.dropped = 0       # terminally failed AFTER running (SLO: 0)
 
@@ -203,6 +216,16 @@ class FleetRouter:
             request.state = "queued"
             request._clock = self._clock
             request.arrival = self._clock()
+            if request.trace is None and self._tracer is not None:
+                tr = self._tracer.begin(request.id,
+                                        force=request.trace_requested)
+                if tr is not None:
+                    request.trace = tr
+                    request._trace_owned = True
+            if request.trace is not None:
+                request.trace.phase(request.arrival, "queued")
+                request.trace.event("submit", request.arrival,
+                                    actor="router")
             self._queue.append(request)
             self._cond.notify_all()
         return request
@@ -239,7 +262,9 @@ class FleetRouter:
         replica and start its pump. Returns False when no ready
         replica exists (requeue); terminal failures are handled."""
         remaining = freq.max_new_tokens - len(freq.generated)
+        tr = freq.trace
         while True:
+            t_pick = self._clock() if tr is not None else 0.0
             with self._lock:
                 rep = self._pick(freq)
                 all_dead = all(r.state == replica_lib.DEAD
@@ -252,6 +277,10 @@ class FleetRouter:
             ereq = engine_lib.Request(
                 freq.prompt + freq.generated, remaining,
                 eos_id=freq.eos_id, sampling=freq.sampling)
+            # the fleet trace rides every per-hop engine request, so
+            # engine spans (admission, prefill chunks, decode batches)
+            # land on the one fleet timeline
+            ereq.trace = tr
             try:
                 rep.engine.submit(ereq)
             except engine_lib.RequestError as e:
@@ -268,6 +297,9 @@ class FleetRouter:
                 return True
             freq.state = "running"
             freq.replica = rep.name
+            if tr is not None:
+                tr.span("dispatch", t_pick, self._clock(),
+                        actor="router", replica=rep.name, hop=freq.hops)
             pump = threading.Thread(
                 target=self._pump, args=(freq, ereq),
                 name=f"hvd_fleet_pump_{freq.id}", daemon=True)
@@ -294,7 +326,17 @@ class FleetRouter:
         on a retryable failure, hand the remainder back to the
         dispatcher as a continuation."""
         try:
+            first = True
             for tok in ereq.stream(timeout=self._stream_timeout):
+                if first:
+                    first = False
+                    if freq.admitted_at is None:
+                        freq.admitted_at = ereq.admitted_at
+                    if freq.trace is not None and freq.hops:
+                        # first token after a hop closes its window
+                        freq.trace.event("resumed", self._clock(),
+                                         actor=freq.replica or "",
+                                         hop=freq.hops)
                 freq.generated.append(tok)
                 freq._emit("token", tok)
             self._finish(freq, ereq.finish_reason)
@@ -323,6 +365,13 @@ class FleetRouter:
             freq.hops += 1
             self.redispatched += 1
             self._requests.labels("redispatched").inc()
+            self._redispatch_counter.inc()
+            if freq.trace is not None:
+                now = self._clock()
+                freq.trace.phase(now, "redispatching")
+                attrs = {"note": note} if note else {}
+                freq.trace.event("cut", now, actor=freq.replica or "",
+                                 hop=freq.hops, **attrs)
             freq.state = "queued"
             self._queue.appendleft(freq)
             self._cond.notify_all()
@@ -335,6 +384,7 @@ class FleetRouter:
         freq.state = "done"
         freq.finish_reason = reason
         freq._emit("done")
+        self._finish_trace(freq, "done", reason=reason)
 
     def _fail(self, freq, message):
         # a drop is a request the fleet ACCEPTED and then lost: it ran
@@ -345,6 +395,18 @@ class FleetRouter:
         freq.state = "failed"
         freq.error = message
         freq._emit("error", message)
+        self._finish_trace(freq, "failed", error=message)
+
+    def _finish_trace(self, freq, outcome, **attrs):
+        tr = freq.trace
+        if tr is None:
+            return
+        now = self._clock()
+        tr.event(outcome, now, actor="router", **attrs)
+        if freq._trace_owned:
+            freq._trace_owned = False
+            if self._tracer is not None:
+                self._tracer.finish(tr, end=now)
 
     # -- lifecycle: drain / evict / preempt ----------------------------------
     def drain_traffic(self, name, grace=None):
@@ -358,6 +420,7 @@ class FleetRouter:
                 return
             rep.state = replica_lib.DRAINING
             rep.engine.set_draining(True)
+            rep.drain_started_at = self._clock()
             self._update_replica_gauge()
         budget = grace if grace is not None else \
             (self._grace if self._grace is not None else 30.0)
@@ -418,9 +481,11 @@ class FleetRouter:
         for name, rep in list(self._replicas.items()):
             if rep.state != replica_lib.READY:
                 continue  # draining/dead replicas are not staged
+            t_roll = self._clock()
             with self._lock:
                 rep.state = replica_lib.DRAINING
                 rep.engine.set_draining(True)
+                rep.drain_started_at = t_roll
                 self._update_replica_gauge()
             try:
                 rep.engine.install_weights(params, version=version)
@@ -435,6 +500,11 @@ class FleetRouter:
                         rep.state = replica_lib.READY
                         rep.engine.set_draining(False)
                         self._update_replica_gauge()
+                # the whole drain -> stage -> swap -> ready window this
+                # replica was out of rotation — the rolling-reload
+                # stall /metrics can show (the engine separately
+                # observes its in-step swap application)
+                self._swap_seconds.observe(self._clock() - t_roll)
                 with self._cond:
                     self._cond.notify_all()
             logger.info("fleet: replica %s rolled to weights version "

@@ -143,7 +143,9 @@ class ServeServer(HttpService):
                         req = Request(tokens,
                                       int(body.get("max_new_tokens", 16)),
                                       eos_id=body.get("eos_id"),
-                                      sampling=sp)
+                                      sampling=sp,
+                                      trace=bool(body.get("trace",
+                                                          False)))
                     except (KeyError, ValueError, TypeError) as e:
                         return self._respond_json(400, {"error": str(e)})
                     try:
@@ -175,10 +177,22 @@ class ServeServer(HttpService):
                     self.wfile.write((json.dumps(obj) + "\n").encode())
                     self.wfile.flush()
 
+                tr = req.trace
+                first = tr is not None
                 try:
                     for tok in req.stream(
                             timeout=server._stream_timeout):
-                        line({"token": tok})
+                        if first:
+                            # best-effort first-byte span: the trace
+                            # may already be finalized for a short
+                            # generation the engine finished first
+                            first = False
+                            t0 = tr.now()
+                            line({"token": tok})
+                            tr.span("stream", t0, tr.now(),
+                                    actor="http", first_byte=True)
+                        else:
+                            line({"token": tok})
                     line({"done": True, "tokens": req.generated,
                           "finish_reason": req.finish_reason})
                 except (RequestError, TimeoutError) as e:

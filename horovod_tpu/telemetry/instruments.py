@@ -76,9 +76,12 @@ SERVE_TOKENS = "hvd_serve_tokens_total"
 SERVE_QUEUE_DEPTH = "hvd_serve_queue_depth"
 SERVE_KV_BLOCKS = "hvd_serve_kv_blocks_in_use"
 SERVE_TTFT_SECONDS = "hvd_serve_ttft_seconds"
+SERVE_TTFT_ADMISSION_SECONDS = "hvd_serve_ttft_admission_seconds"
 SERVE_INTER_TOKEN_SECONDS = "hvd_serve_inter_token_seconds"
 SERVE_CACHED_PREFILL_TOKENS = "hvd_serve_cached_prefill_tokens_total"
 SERVE_REPLICAS = "hvd_serve_replicas"
+SERVE_REDISPATCH_TOTAL = "hvd_serve_redispatch_total"
+SERVE_WEIGHT_SWAP_SECONDS = "hvd_serve_weight_swap_seconds"
 # -- goodput ledger (telemetry/ledger.py, docs/OBSERVABILITY.md) ------------
 TIME_SECONDS = "hvd_time_seconds_total"
 GOODPUT_RATIO = "hvd_goodput_ratio"
@@ -129,8 +132,10 @@ CATALOGUE = (
     DATA_WAIT_SECONDS, DATA_LOAD_SECONDS, DATA_QUEUE_DEPTH,
     DATA_BYTES_STAGED, DATA_BATCHES,
     SERVE_REQUESTS, SERVE_TOKENS, SERVE_QUEUE_DEPTH, SERVE_KV_BLOCKS,
-    SERVE_TTFT_SECONDS, SERVE_INTER_TOKEN_SECONDS,
+    SERVE_TTFT_SECONDS, SERVE_TTFT_ADMISSION_SECONDS,
+    SERVE_INTER_TOKEN_SECONDS,
     SERVE_CACHED_PREFILL_TOKENS, SERVE_REPLICAS,
+    SERVE_REDISPATCH_TOTAL, SERVE_WEIGHT_SWAP_SECONDS,
     TIME_SECONDS, GOODPUT_RATIO, BUILD_INFO,
 )
 
@@ -508,12 +513,18 @@ class ServeInstruments:
             SERVE_TTFT_SECONDS,
             "Time to first token: request arrival -> first streamed "
             "token (queueing + prefill)")
+        self.ttft_admission_seconds = r.histogram(
+            SERVE_TTFT_ADMISSION_SECONDS,
+            "Time to first token from KV admission -> first streamed "
+            "token (prefill only; the arrival-based histogram folds "
+            "queue wait in, this one separates it)")
         self.inter_token_seconds = r.histogram(
             SERVE_INTER_TOKEN_SECONDS,
             "Gap between successive streamed tokens of one request "
             "(steady-state decode cadence)",
             buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5,
                      1.0, 2.5))
+        self.weight_swap_seconds = serve_weight_swap_histogram(r)
 
 
 def serve_instruments(registry=None, replica="default"):
@@ -528,6 +539,31 @@ def serve_replicas_gauge(registry=None):
     return r.gauge(SERVE_REPLICAS,
                    "Serve-fleet replicas by state (ready / draining / "
                    "dead)", label_names=("state",))
+
+
+def serve_redispatch_counter(registry=None):
+    """The one declaration of ``hvd_serve_redispatch_total`` — streams
+    cut by a replica eviction and continued on a survivor
+    (serve/fleet/router.py zero-drop re-dispatch hops)."""
+    r = registry if registry is not None else get_registry()
+    return r.counter(
+        SERVE_REDISPATCH_TOTAL,
+        "Streams cut mid-generation and re-dispatched onto a surviving "
+        "replica (each count is one hop)")
+
+
+def serve_weight_swap_histogram(registry=None):
+    """The one declaration of ``hvd_serve_weight_swap_seconds``, shared
+    by the engine (in-step staged-swap application) and the router (the
+    per-replica drain -> stage -> swap -> ready rolling-reload window) so
+    both record into one family."""
+    r = registry if registry is not None else get_registry()
+    return r.histogram(
+        SERVE_WEIGHT_SWAP_SECONDS,
+        "Weight-swap stall windows: engine in-step staged-swap "
+        "application and router per-replica rolling-reload "
+        "(drain -> stage -> swap -> ready)",
+        buckets=(.001, .005, .01, .05, .1, .5, 1.0, 5.0, 15.0, 60.0))
 
 
 def build_info_labels(config=None):

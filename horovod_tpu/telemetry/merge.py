@@ -31,6 +31,10 @@ import re
 import sys
 
 CLOCK_SYNC = "hvd_clock_sync"
+# Flow events in this category carry GLOBALLY allocated ids (the serve
+# tracer's request-hop arrows, serve/tracing.py): one id deliberately
+# spans several pids, so the merge must NOT per-rank-namespace it.
+GLOBAL_FLOW_CAT = "hvd_global_flow"
 _RANK_RE = re.compile(r"\.rank(\d+)\.")
 
 
@@ -109,10 +113,13 @@ def merge_traces(paths, out_path=None):
             ev["pid"] = rank
             if "ts" in ev:
                 ev["ts"] = ev["ts"] + shift
-            if ev.get("ph") in ("s", "t", "f") and "id" in ev:
+            if ev.get("ph") in ("s", "t", "f") and "id" in ev \
+                    and ev.get("cat") != GLOBAL_FLOW_CAT:
                 # flow ids are per-rank counters; Chrome binds s/t/f
                 # globally by (cat, id), so un-namespaced ids would draw
-                # bogus cross-rank arrows
+                # bogus cross-rank arrows. GLOBAL_FLOW_CAT ids are
+                # allocated fleet-wide and WANT to cross pids (a
+                # re-dispatched request's hop arrow).
                 ev["id"] = int(ev["id"]) + rank * 1_000_000
             if ev.get("ph") == "M" and ev.get("name") == "process_name":
                 named = True
