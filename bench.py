@@ -447,6 +447,12 @@ def spmd_comparison(args):
             result[f"compiled_collective_bytes_{name}"] = {
                 op: t["bytes"]
                 for op, t in step.compiled_collectives.items()}
+        if name == "gspmd":
+            # X-ray the uncompressed GSPMD step: where the compiled
+            # step's device time goes, gated on the classifier naming
+            # >=95% of it (state threads through the traced steps)
+            state = _attach_step_attribution(result, step, state,
+                                             images, labels)
         _record_step_time(args, step, state, images, labels, result, name)
 
     # -- LM path (the shared make_lm_bench workload, data-sharded) -----
@@ -815,6 +821,40 @@ def _attach_goodput(result):
         result["goodput_error"] = (str(e) or repr(e)).splitlines()[0][:160]
 
 
+def _attach_step_attribution(result, step, state, images, labels, k=3):
+    """The BENCH ``step_attribution`` block (the training twin of
+    bench_serve's ``tail_attribution``): X-ray K compiled steps
+    (``step.xray`` → telemetry/xprof.py) and attach the device-time
+    buckets, exposed-vs-overlapped collective split and verdict. The
+    honesty gate is ENFORCED — a ``bucketed_fraction`` below 95% means
+    the classifier can no longer name this backend's device time, and
+    that is a loud error (stderr + ``step_attribution_error``), never
+    silence. Returns the threaded ``state`` (the traced steps donate
+    their inputs as usual)."""
+    import sys
+
+    from horovod_tpu.telemetry import xprof
+    try:
+        state, summary = step.xray(state, images, labels, k=k)
+        result["step_attribution"] = summary
+        if summary["bucketed_fraction"] < xprof.BUCKETED_GATE:
+            msg = (f"step_attribution bucketed only "
+                   f"{summary['bucketed_fraction']:.1%} of device time "
+                   f"(gate {xprof.BUCKETED_GATE:.0%}) — unattributed "
+                   f"{summary['unattributed_seconds']:.4f}s; the trace "
+                   "classifier no longer understands this backend's "
+                   "events")
+            print(f"bench: STEP ATTRIBUTION GATE FAILED: {msg}",
+                  file=sys.stderr)
+            result["step_attribution_error"] = msg
+    # hvd-lint: disable=HVD-EXCEPT -- record, don't die: error lands in the result block
+    except Exception as e:  # noqa: BLE001 — record, don't die
+        err = (str(e) or repr(e)).splitlines()[0][:160]
+        print(f"bench: STEP ATTRIBUTION FAILED: {err}", file=sys.stderr)
+        result["step_attribution_error"] = err
+    return state
+
+
 def _checkpoint_block(nbytes=32 << 20):
     """Async-checkpoint microbench for the BENCH json (docs/
     CHECKPOINT.md): for a synthetic ``nbytes`` state, the synchronous
@@ -983,6 +1023,18 @@ def main():
     parser.add_argument("--churn-drain-ms", type=float, default=40.0,
                         help="simulated drain window per preemption "
                              "(announce + exit + relaunch stand-in)")
+    parser.add_argument("--compare", nargs="*", default=None,
+                        metavar="DIR_OR_FILE",
+                        help="run NO benchmark: diff the checked-in "
+                             "BENCH_*.json rounds (default: current "
+                             "directory) and flag regressions worse "
+                             "than --compare-threshold on step_ms, "
+                             "MFU, goodput and serve tokens/s "
+                             "(telemetry/trend.py); exits 1 when any "
+                             "metric regressed")
+    parser.add_argument("--compare-threshold", type=float, default=5.0,
+                        help="--compare regression threshold in "
+                             "percent (default 5)")
     args = parser.parse_args()
     if args.repeats < 1:
         parser.error("--repeats must be >= 1")
@@ -1001,6 +1053,21 @@ def main():
         parser.error("--churn is its own comparison mode; run it "
                      "separately from --overlap/--compression/"
                      "--data-plane/--spmd")
+    if args.compare is not None:
+        if (args.overlap or args.compression is not None
+                or args.data_plane or args.spmd or args.churn):
+            parser.error("--compare reads past rounds; it does not "
+                         "combine with a benchmark mode")
+        import sys
+
+        from horovod_tpu.telemetry import trend
+        report = trend.run(args.compare,
+                           threshold=args.compare_threshold / 100.0,
+                           stream=sys.stderr)
+        if report is None:
+            sys.exit(2)
+        print(json.dumps(report))
+        sys.exit(1 if report["regressions"] else 0)
     if args.churn:
         if args.churn_steps < 2:
             parser.error("--churn-steps must be >= 2")

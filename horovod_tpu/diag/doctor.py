@@ -401,28 +401,52 @@ def main(argv=None):
     p.add_argument("--expected-size", type=int, default=None,
                    help="world size to check for missing ranks (default: "
                         "from the dumps)")
+    p.add_argument("--json", action="store_true",
+                   help="print the report dict as JSON on stdout (the "
+                        "human-readable report moves to stderr)")
     args = p.parse_args(argv)
     report = run(args.logdir, expected_size=args.expected_size,
-                 stream=sys.stdout)
+                 stream=sys.stderr if args.json else sys.stdout)
+    if report is not None and args.json:
+        import json as _json
+        print(_json.dumps(report, indent=2, sort_keys=True, default=str))
     return 2 if report is None else 0
 
 
+def _perf_main(argv):
+    from horovod_tpu.telemetry import report
+    return report.main(argv)
+
+
+def _serve_main(argv):
+    from horovod_tpu.diag import serve_doctor
+    return serve_doctor.main(argv)
+
+
+def _xray_main(argv):
+    from horovod_tpu.diag import xray
+    return xray.main(argv)
+
+
+# ONE dispatch table for every doctor, all sharing the same
+# conventions: a dump-dir positional, --json for machine output (report
+# prose moves to stderr), exit 2 when the dir holds nothing readable
+SUBCOMMANDS = {
+    "hang": main,          # flight-recorder hang/crash report (default)
+    "perf": _perf_main,    # goodput-ledger host-time attribution
+    "serve": _serve_main,  # per-request tail-latency attribution
+    "xray": _xray_main,    # compiled-step device-time attribution
+}
+
+
 def doctor_cli(argv=None):
-    """The ``hvd-doctor`` entry point: ``hvd-doctor [hang] <logdir>``
-    runs this module's hang/crash report; ``hvd-doctor perf <logdir>``
-    runs the goodput time-attribution report
-    (``horovod_tpu.telemetry.report``); ``hvd-doctor serve <dir>``
-    runs the serving tail-latency report over per-request trace dumps
-    (``horovod_tpu.diag.serve_doctor``)."""
+    """The ``hvd-doctor`` entry point — ``hvd-doctor <subcommand>
+    <dir> [--json]`` with the subcommands in :data:`SUBCOMMANDS`;
+    a bare ``hvd-doctor <dir>`` keeps meaning ``hang`` (the original
+    interface)."""
     argv = list(sys.argv[1:] if argv is None else argv)
-    if argv and argv[0] == "perf":
-        from horovod_tpu.telemetry import report
-        return report.main(argv[1:])
-    if argv and argv[0] == "serve":
-        from horovod_tpu.diag import serve_doctor
-        return serve_doctor.main(argv[1:])
-    if argv and argv[0] == "hang":
-        argv = argv[1:]
+    if argv and argv[0] in SUBCOMMANDS:
+        return SUBCOMMANDS[argv[0]](argv[1:])
     return main(argv)
 
 

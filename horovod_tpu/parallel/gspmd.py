@@ -295,6 +295,43 @@ def apply_shards_spmd(tx, grads, zstate, params, plan, wire=None,
 
 # -- compiled-HLO byte accounting -------------------------------------------
 
+# The collective kinds this framework prices and attributes, in one
+# place: the HLO byte parser below, the device-trace X-ray
+# (telemetry/xprof.py) and the doctor's bandwidth join all derive their
+# matching from this tuple + classifier — one authority, so a kind
+# added here is priced AND time-attributed, and the two views can never
+# drift on what counts as a collective.
+COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                  "all-to-all", "collective-permute")
+
+# kinds as they appear in metric labels / summary JSON (dashes don't
+# survive Prometheus label conventions)
+def collective_label(op):
+    return op.replace("-", "_")
+
+
+_COLLECTIVE_KIND_RE = re.compile(
+    r"^(" + "|".join(re.escape(op) for op in COLLECTIVE_OPS) + r")"
+    r"(-start|-done)?(?:[.\-_]|\d|$)")
+
+
+def collective_kind(name):
+    """Classify one HLO instruction/op/trace-event name against
+    :data:`COLLECTIVE_OPS`: returns ``(kind, async_edge)`` where
+    ``kind`` is the base op (``"all-reduce"``) and ``async_edge`` is
+    ``"start"``/``"done"`` for the latency-hiding scheduler's async
+    pair halves (``all-reduce-start.1``), else ``None`` — or
+    ``(None, None)`` when the name is not a collective. Longest-match
+    first, so ``all-reduce-scatter-fusion``-style names cannot
+    misclassify (``reduce-scatter`` is matched before a bare prefix
+    could lie)."""
+    m = _COLLECTIVE_KIND_RE.match(name)
+    if not m:
+        return None, None
+    edge = m.group(2)
+    return m.group(1), edge[1:] if edge else None
+
+
 # `%name = f32[128,256]{1,0} all-reduce(...)` — result dtype/shape, then
 # the collective op. Two wrinkles:
 #
@@ -313,14 +350,13 @@ def apply_shards_spmd(tx, grads, zstate, params, plan, wire=None,
 #   gradient tensors into one variadic collective) — so sum only the
 #   OUTPUT half; counting the input aliases too would double the
 #   bytes.
+_HLO_OP_ALTERNATION = "|".join(re.escape(op) for op in COLLECTIVE_OPS)
 _HLO_RESULT_RE = re.compile(
     r"=\s*([a-z][a-z0-9]*)\[([0-9,]*)\][^=]*?"
-    r"\b(all-reduce|reduce-scatter|all-gather|all-to-all|"
-    r"collective-permute)(-start)?\(")
+    r"\b(" + _HLO_OP_ALTERNATION + r")(-start)?\(")
 _HLO_TUPLE_RE = re.compile(
     r"=\s*\(.*?\)\s*"
-    r"(all-reduce|reduce-scatter|all-gather|all-to-all|"
-    r"collective-permute)(-start)?\(")
+    r"(" + _HLO_OP_ALTERNATION + r")(-start)?\(")
 _HLO_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
 
 _HLO_ITEMSIZE = {

@@ -85,6 +85,11 @@ SERVE_WEIGHT_SWAP_SECONDS = "hvd_serve_weight_swap_seconds"
 # -- goodput ledger (telemetry/ledger.py, docs/OBSERVABILITY.md) ------------
 TIME_SECONDS = "hvd_time_seconds_total"
 GOODPUT_RATIO = "hvd_goodput_ratio"
+# -- compiled-step X-ray (telemetry/xprof.py, hvd-doctor xray) --------------
+XRAY_DEVICE_SECONDS = "hvd_xray_device_seconds"
+XRAY_BUCKETED_FRACTION = "hvd_xray_bucketed_fraction"
+XRAY_EXPOSED_SECONDS = "hvd_xray_exposed_collective_seconds"
+XRAY_COLLECTIVE_GBPS = "hvd_xray_collective_bandwidth_gbps"
 # -- process identity -------------------------------------------------------
 BUILD_INFO = "hvd_build_info"
 
@@ -136,7 +141,10 @@ CATALOGUE = (
     SERVE_INTER_TOKEN_SECONDS,
     SERVE_CACHED_PREFILL_TOKENS, SERVE_REPLICAS,
     SERVE_REDISPATCH_TOTAL, SERVE_WEIGHT_SWAP_SECONDS,
-    TIME_SECONDS, GOODPUT_RATIO, BUILD_INFO,
+    TIME_SECONDS, GOODPUT_RATIO,
+    XRAY_DEVICE_SECONDS, XRAY_BUCKETED_FRACTION,
+    XRAY_EXPOSED_SECONDS, XRAY_COLLECTIVE_GBPS,
+    BUILD_INFO,
 )
 
 # the default registry serves the legacy names on every scrape until the
@@ -401,6 +409,40 @@ def record_bucket(kind, fill_ratio, nbytes, dispatch_s=None,
     _ensure_ratio_gauge()
     if dispatch_s is not None:
         dispatch.observe(dispatch_s)
+
+
+def record_xray(summary, registry=None):
+    """Mirror a compiled-step X-ray summary (``telemetry/xprof.py``)
+    into the ``hvd_xray_*`` gauge family so the last capture's
+    attribution rides every scrape: per-category device seconds (idle
+    included), the bucketed-fraction honesty gate, and per-collective
+    exposed seconds + effective exchange bandwidth. Gauges, not
+    counters — each capture REPLACES the previous one's values (an
+    X-ray is a snapshot of K steps, not a running total)."""
+    r = registry if registry is not None else get_registry()
+    dev = r.gauge(XRAY_DEVICE_SECONDS,
+                  "Device time per op category over the last X-ray "
+                  "capture (K compiled steps)",
+                  label_names=("category",))
+    for cat, sec in summary.get("device_seconds", {}).items():
+        dev.labels(cat).set(sec)
+    r.gauge(XRAY_BUCKETED_FRACTION,
+            "Share of last-capture device time the X-ray classifier "
+            "could name (1 - unattributed; gated at 0.95 by "
+            "bench.py --spmd)").set(summary.get("bucketed_fraction", 0.0))
+    exposed = r.gauge(XRAY_EXPOSED_SECONDS,
+                      "Collective in-flight time NOT hidden behind "
+                      "compute over the last X-ray capture",
+                      label_names=("op",))
+    gbps = r.gauge(XRAY_COLLECTIVE_GBPS,
+                   "Effective exchange bandwidth per collective over "
+                   "the last X-ray capture (aggregate HLO bytes / "
+                   "in-flight seconds)",
+                   label_names=("op",))
+    for op, slot in summary.get("collectives", {}).items():
+        exposed.labels(op).set(slot.get("exposed_seconds", 0.0))
+        if "effective_gbps" in slot:
+            gbps.labels(op).set(slot["effective_gbps"])
 
 
 class CkptInstruments:

@@ -15,7 +15,13 @@ Phases (exclusive — each second lands in exactly one):
   (the compiled overlap pipeline) are compute by design — only exposed
   dispatch time is charged separately.
 * ``exposed_collective``  — host time spent dispatching eager
-  collectives (time the step could not hide).
+  collectives (time the step could not hide). Under ``spmd=True`` this
+  phase is STRUCTURALLY zero — the collectives are compiled into the
+  step and their time books as ``compute``; the step wrappers call
+  :meth:`TimeLedger.note_compiled_path` so snapshots/dumps carry a
+  ``compiled_path`` flag and the report annotates the zero instead of
+  implying "no exposed comms" (run ``hvd-doctor xray`` for the
+  device-side split).
 * ``data_wait``           — the training thread blocked on the input
   pipeline (``hvd_data_wait_seconds``'s source, charged here too).
 * ``ckpt_stall``          — the blocking portion of checkpoint saves
@@ -148,6 +154,7 @@ class TimeLedger:
         self._steps_settled = 0
         self._counters = None    # phase -> registry counter child
         self._gauge_installed = False
+        self.compiled_path = False  # any spmd step settled this run
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -190,6 +197,15 @@ class TimeLedger:
                 # the bracket books only what is left, keeping phases
                 # exclusive
                 self._open[-1].inner += seconds
+
+    def note_compiled_path(self):
+        """Mark this run as a compiled-path (GSPMD) run: its
+        ``exposed_collective`` phase is structurally zero because the
+        collectives live inside the compiled step. Snapshots, dumps and
+        ``hvd-doctor perf`` annotate the zero instead of implying no
+        exposed comms — the device-side answer is ``hvd-doctor xray``.
+        Called by the spmd step wrappers; idempotent, a bool store."""
+        self.compiled_path = True
 
     def phase(self, label, charge=None, health=True):
         """Context manager bracketing a blocking span: the elapsed time
@@ -346,6 +362,7 @@ class TimeLedger:
                 "unattributed_seconds": unattributed,
                 "goodput_ratio": ratio,
                 "steps": self._steps_settled,
+                "compiled_path": self.compiled_path,
             }
 
     def finalize(self, now=None):
@@ -410,6 +427,7 @@ class TimeLedger:
             "unattributed_seconds": round(snap["unattributed_seconds"], 6),
             "goodput_ratio": round(snap["goodput_ratio"], 6),
             "steps": snap["steps"],
+            "compiled_path": snap["compiled_path"],
         }
         try:
             from horovod_tpu.telemetry import instruments as _tele

@@ -121,6 +121,7 @@ def aggregate(dumps):
             "dominant_sink_seconds": sink_s,
             "steps": d.get("steps"),
             "build_info": d.get("build_info"),
+            "compiled_path": bool(d.get("compiled_path")),
             "path": d.get("_path"),
         }
         for p in PHASES:
@@ -138,6 +139,8 @@ def aggregate(dumps):
             "goodput_ratio": fleet["compute"] / f_attr if f_attr else 1.0,
             "dominant_sink": f_sink,
             "dominant_sink_seconds": f_sink_s,
+            "compiled_path": any(i["compiled_path"]
+                                 for i in per_rank.values()),
         },
     }
 
@@ -195,6 +198,12 @@ def format_report(report):
         add(f"  {'(unattributed)':<20} "
             f"{fleet['unattributed_seconds']:>10.2f}s  "
             f"{_pct(fleet['unattributed_seconds'], wall):5.1f}%")
+    if fleet.get("compiled_path") and \
+            fleet["phases"].get("exposed_collective", 0.0) == 0.0:
+        add("note: compiled-path (GSPMD) run — collective time is "
+            "inside the compiled step and books as compute; "
+            "exposed_collective=0 is structural, not 'no comms'. "
+            "Run `hvd-doctor xray` for the device-side split.")
     if fleet["dominant_sink"]:
         add(f"DOMINANT TIME SINK (fleet): {fleet['dominant_sink']} — "
             f"{fleet['dominant_sink_seconds']:.2f}s "
@@ -293,6 +302,7 @@ def goodput_block(ledger=None, validate=True):
         "unattributed_seconds": round(snap["unattributed_seconds"], 4),
         "goodput_ratio": round(snap["goodput_ratio"], 4),
         "steps": snap["steps"],
+        "compiled_path": snap.get("compiled_path", False),
     }
     if validate:
         validate_goodput_block(block)
@@ -312,8 +322,14 @@ def main(argv=None):
                         "output) to cross-check ledger wall times "
                         "against (default: <logdir>/merged.json when "
                         "present)")
+    p.add_argument("--json", action="store_true",
+                   help="print the report dict as JSON on stdout "
+                        "(the human-readable report moves to stderr)")
     args = p.parse_args(argv)
-    report = run(args.logdir, trace=args.trace, stream=sys.stdout)
+    report = run(args.logdir, trace=args.trace,
+                 stream=sys.stderr if args.json else sys.stdout)
+    if report is not None and args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
     return 2 if report is None else 0
 
 

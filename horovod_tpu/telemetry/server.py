@@ -14,9 +14,16 @@ when ``HOROVOD_METRICS_PORT`` is configured:
 * ``GET /profile?seconds=N`` — on-demand ``jax.profiler`` device trace:
   starts a capture into ``HOROVOD_PROFILE_DIR`` (default
   ``/tmp/horovod_tpu_profile``), stops it after N seconds on a worker
-  thread, responds immediately with the output directory. Load the
-  result in TensorBoard/XProf or Perfetto and line it up with the host
-  trace via docs/OBSERVABILITY.md.
+  thread, responds immediately with the output directory. The worker
+  then runs the capture through the compiled-step X-ray parser
+  (``telemetry/xprof.py``) and drops an ``xray.rank<r>.json`` next to
+  the trace, so the dump is never a bare capture nobody can read:
+  ``?wait=1`` blocks the response until capture+parse finish and
+  returns the attribution summary inline, ``?result=1`` fetches the
+  last capture's summary, and ``hvd-doctor xray <dir>`` reads the same
+  artifacts offline. Load the raw trace in TensorBoard/XProf or
+  Perfetto and line it up with the host trace via
+  docs/OBSERVABILITY.md.
 
 Security note (docs/OBSERVABILITY.md): the server binds
 ``HOROVOD_METRICS_ADDR`` = 127.0.0.1 by default. The endpoints are
@@ -57,6 +64,7 @@ class MetricsServer(HttpService):
         self._profile_active = False
         self._profile_thread = None
         self._profile_cancel = threading.Event()
+        self._profile_summary = None  # last capture's X-ray attribution
 
     # -- profiling ----------------------------------------------------------
     def _start_profile(self, seconds):
@@ -79,6 +87,7 @@ class MetricsServer(HttpService):
                 jax.profiler.start_trace(self.profile_dir)
                 self._profile_cancel.wait(seconds)
                 jax.profiler.stop_trace()
+                self._profile_summary = self._attribute_capture()
             # hvd-lint: disable=HVD-EXCEPT -- profiler capture is best-effort; the failure is logged
             except Exception:
                 logger.warning("profile capture failed", exc_info=True)
@@ -90,6 +99,28 @@ class MetricsServer(HttpService):
             target=_capture, daemon=True, name="hvd_tpu_profile")
         self._profile_thread.start()
         return self.profile_dir
+
+    def _attribute_capture(self):
+        """Run the finished capture through the X-ray parser
+        (``telemetry/xprof.py``): drops ``xray.rank<r>.json`` next to
+        the trace for ``hvd-doctor xray`` and returns the attribution
+        summary the HTTP response serves (``?wait=1`` / ``?result=1``).
+        A torn or empty capture returns ``{"error": ...}``."""
+        from horovod_tpu.telemetry import xprof
+        try:
+            summary = xprof.analyze_capture(self.profile_dir)
+        except ValueError as e:
+            return {"error": str(e)}
+        try:
+            from horovod_tpu import basics
+            rank = basics.rank()
+        # hvd-lint: disable=HVD-EXCEPT -- uninitialized runtime defaults to rank 0
+        except Exception:
+            rank = 0
+        xprof.write_summary(summary,
+                            summary.get("capture_dir", self.profile_dir),
+                            rank=rank)
+        return summary
 
     # -- server -------------------------------------------------------------
     def _handler_class(self):
@@ -137,17 +168,49 @@ class MetricsServer(HttpService):
                                 "application/json")
                     elif url.path == "/profile":
                         q = parse_qs(url.query)
+                        if q.get("result", ["0"])[0] not in ("0", ""):
+                            # the last capture's attribution, no new
+                            # capture started
+                            s = server._profile_summary
+                            if s is None:
+                                self._respond(404, json.dumps(
+                                    {"error": "no finished capture; "
+                                              "GET /profile?seconds=N "
+                                              "first"}),
+                                    "application/json")
+                            else:
+                                self._respond(200, json.dumps(
+                                    {"output_dir": server.profile_dir,
+                                     "summary": s}), "application/json")
+                            return
                         seconds = float(q.get("seconds", ["3"])[0])
                         seconds = min(max(seconds, 0.1), 600.0)
+                        wait = q.get("wait", ["0"])[0] not in ("0", "")
                         out = server._start_profile(seconds)
                         if out is None:
                             self._respond(409, json.dumps(
                                 {"error": "a profile capture is already "
                                           "running"}), "application/json")
+                        elif wait:
+                            # block until capture + X-ray parse finish
+                            # and return the attribution inline (the
+                            # cold profiler start is why async is the
+                            # default; opt into the wait explicitly)
+                            server._profile_thread.join(
+                                timeout=seconds + 120)
+                            self._respond(200, json.dumps(
+                                {"profiling_seconds": seconds,
+                                 "output_dir": out,
+                                 "summary": server._profile_summary}),
+                                "application/json")
                         else:
                             self._respond(200, json.dumps(
                                 {"profiling_seconds": seconds,
-                                 "output_dir": out}), "application/json")
+                                 "output_dir": out,
+                                 "result": "/profile?result=1 after the "
+                                           "capture finishes, or "
+                                           "hvd-doctor xray on the dir"}),
+                                "application/json")
                     else:
                         self._respond(404, "not found\n", "text/plain")
                 except BrokenPipeError:

@@ -1174,7 +1174,9 @@ def _make_spmd_train_step(model, tx, mesh=None,
         loss = rest[0]
         gnorm = rest[1] if tele_on else None
         _flightrec.step_end(n)
-        _ledger_lib.get_ledger().settle_step()
+        ledger = _ledger_lib.get_ledger()
+        ledger.note_compiled_path()
+        ledger.settle_step()
         if instruments is not None:
             instruments.record_step(
                 batch=int(inputs.shape[0]),
@@ -1182,6 +1184,20 @@ def _make_spmd_train_step(model, tx, mesh=None,
                 loss=loss, grad_norm=gnorm,
                 step_no=instruments.steps.value)
         return new_state, loss
+
+    def xray(state, inputs=None, labels=None, k=3, profile_dir=None):
+        """Opt-in compiled-step X-ray: run K steps of the ALREADY
+        compiled executable under a device trace and attribute where
+        the device time went (telemetry/xprof.py). Capture wraps
+        around the dispatch — the compiled program is byte-identical
+        with X-ray off. State threads through the captured steps
+        (donation as usual): returns ``(new_state, summary)``."""
+        from horovod_tpu.telemetry import xprof as _xprof
+        if inputs is None:
+            inputs, labels = _loader_batch()
+        return _xprof.xray_run(
+            step, state, (inputs, labels), k=k, profile_dir=profile_dir,
+            compiled_collectives=lambda: step.compiled_collectives)
 
     def lower(state, inputs, labels):
         if use_ef:
@@ -1205,6 +1221,7 @@ def _make_spmd_train_step(model, tx, mesh=None,
     step.spmd = True
     step.compiled_collectives = None  # set at first call
     step._settles_ledger = True
+    step.xray = xray
     return step
 
 
@@ -1361,7 +1378,9 @@ def _make_spmd_lm_train_step(model, tx, mesh=None, batch_axis="data",
         step.compiled_collectives = prog.compiled_collectives
         out = ex(*placed)
         _flightrec.step_end(n)
-        _ledger_lib.get_ledger().settle_step()
+        ledger = _ledger_lib.get_ledger()
+        ledger.note_compiled_path()
+        ledger.settle_step()
         return out
 
     def lower(state, tokens):
@@ -1370,12 +1389,23 @@ def _make_spmd_lm_train_step(model, tx, mesh=None, batch_axis="data",
         step.jitted = prog.jitted
         return lowered
 
+    def xray(state, tokens, k=3, profile_dir=None):
+        """Compiled-step X-ray for the LM step — see the ResNet twin:
+        K traced executions of the already-compiled program, device
+        time attributed by telemetry/xprof.py. Returns
+        ``(new_state, summary)``."""
+        from horovod_tpu.telemetry import xprof as _xprof
+        return _xprof.xray_run(
+            step, state, (tokens,), k=k, profile_dir=profile_dir,
+            compiled_collectives=lambda: step.compiled_collectives)
+
     step.jitted = None
     step.lower = lower
     step.plan = plan
     step.spmd = True
     step.compiled_collectives = None
     step._settles_ledger = True
+    step.xray = xray
     return step
 
 
