@@ -1026,12 +1026,13 @@ def main():
     parser.add_argument("--compare", nargs="*", default=None,
                         metavar="DIR_OR_FILE",
                         help="run NO benchmark: diff the checked-in "
-                             "BENCH_*.json rounds (default: current "
-                             "directory) and flag regressions worse "
-                             "than --compare-threshold on step_ms, "
-                             "MFU, goodput and serve tokens/s "
-                             "(telemetry/trend.py); exits 1 when any "
-                             "metric regressed")
+                             "BENCH_*.json and SCALING_*.json rounds "
+                             "(default: current directory) and flag "
+                             "regressions worse than "
+                             "--compare-threshold on step_ms, MFU, "
+                             "goodput, serve tokens/s and per-world "
+                             "scaling efficiency (telemetry/trend.py); "
+                             "exits 1 when any metric regressed")
     parser.add_argument("--compare-threshold", type=float, default=5.0,
                         help="--compare regression threshold in "
                              "percent (default 5)")

@@ -4,5 +4,6 @@ catalogue with one true-positive and one justified-suppression example
 per rule is docs/ANALYSIS.md."""
 
 from horovod_tpu.analysis.rules import (  # noqa: F401
-    desync, excepts, hostsync, lockorder, mesh, metric, sigsafe,
+    desync, distinit, excepts, hostsync, lockorder, mesh, metric,
+    sigsafe,
 )

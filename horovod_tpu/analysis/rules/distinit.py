@@ -1,0 +1,53 @@
+"""HVD-DISTINIT: ``jax.distributed.initialize`` call sites outside the
+one sanctioned entry point, ``cluster/procmesh.ensure_distributed``.
+
+Joining the multi-process runtime is a process-global, once-only act
+with hard ordering constraints (before any backend touch, after the
+CPU collectives implementation and forced device count are set). A
+second call site either races the first for the coordinator or runs
+after the backend initialized and dies with an opaque XLA error — and
+every such bug reproduces only under a real multi-process launch, the
+most expensive place to debug it. ``ensure_distributed`` owns the
+idempotence record, the foreign-init adoption path, and the CPU
+bring-up ordering; everything else in the tree must go through it.
+
+``compat.py`` rides the usual version-shim exclusion.
+"""
+
+import ast
+
+from horovod_tpu.analysis import engine
+from horovod_tpu.analysis.rules import common
+
+_SANCTIONED_SUFFIXES = ("horovod_tpu/cluster/procmesh.py",
+                        "horovod_tpu/compat.py")
+
+
+def _is_distributed_initialize(node):
+    if common.call_name(node) != "initialize":
+        return False
+    recv = common.receiver_ident(node) or ""
+    return recv == "distributed" or recv.endswith(".distributed")
+
+
+@engine.register(
+    "HVD-DISTINIT",
+    doc="jax.distributed.initialize outside cluster.ensure_distributed")
+def check(pf):
+    rel = pf.rel.replace("\\", "/")
+    if rel.endswith(_SANCTIONED_SUFFIXES):
+        return []
+    findings = []
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Call) and _is_distributed_initialize(node):
+            findings.append(engine.Finding(
+                rule="HVD-DISTINIT", file=pf.rel, line=node.lineno,
+                col=node.col_offset + 1,
+                message="jax.distributed.initialize outside the "
+                        "sanctioned cluster entry point",
+                hint="join the multi-process runtime through "
+                     "cluster.ensure_distributed() — it owns the "
+                     "idempotence record, foreign-init adoption and "
+                     "the CPU collectives bring-up ordering",
+                fingerprint=common.fingerprint(pf, node.lineno)))
+    return findings

@@ -90,18 +90,24 @@ def init(num_slices=None, devices=None):
         # Multi-process: join the distributed JAX runtime so jax.devices()
         # spans every chip in the job. The coordinator address is provided by
         # the hvdrun launcher (TPU analogue of the gloo rendezvous address,
-        # gloo_context.cc:41-50).
-        coord = os.environ.get("HOROVOD_COORDINATOR_ADDR")
-        if cfg.size > 1 and coord:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=cfg.size,
-                process_id=cfg.rank,
-            )
+        # gloo_context.cc:41-50). cluster.ensure_distributed is the one
+        # sanctioned jax.distributed.initialize call site (HVD-DISTINIT)
+        # and also arms the CPU gloo collectives + forced per-process
+        # device count before the first backend touch.
+        from horovod_tpu.cluster import procmesh
+        multiproc = procmesh.ensure_distributed(cfg)
 
-        if num_slices is None:
-            num_slices = cfg.cross_size if cfg.cross_size > 1 else 1
-        m = mesh_lib.build_mesh(devices=devices, num_slices=num_slices)
+        if multiproc and jax.process_count() > 1 and devices is None and \
+                num_slices in (None, jax.process_count()):
+            # ONE logical mesh spanning every process: dcn outer axis =
+            # the process tier (DCN), data minor axis = this host's ICI
+            # tier (docs/SCALING.md).
+            m = procmesh.build_process_mesh()
+            procmesh.assert_process_contiguous(m)
+        else:
+            if num_slices is None:
+                num_slices = cfg.cross_size if cfg.cross_size > 1 else 1
+            m = mesh_lib.build_mesh(devices=devices, num_slices=num_slices)
         mesh_lib.set_mesh(m)
 
         _state.config = cfg

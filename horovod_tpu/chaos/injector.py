@@ -22,6 +22,13 @@ Kind semantics against a POSIX process:
   rank, which elongates its checkpoint writes and step times without
   killing it. (True fault injection at the filesystem layer needs
   privileges a test harness cannot assume.)
+* ``host_sigterm`` / ``host_sigkill`` — the rank draw picks a live
+  *host* (``Job.slots`` hostnames) and EVERY live rank on it gets the
+  signal: preemption at the granularity it actually arrives on
+  multi-host pods. The graceful form lets every rank's eviction
+  handler announce the host, so the elastic driver records a *drain*
+  (no blacklist penalty) rather than N crashes — drained ≠ crashed at
+  host scope (elastic/driver.py Blacklist).
 """
 
 import signal
@@ -47,6 +54,8 @@ class ChaosMonkey:
     def __init__(self, plan, clock=time.monotonic, sleep=time.sleep):
         self.plan = plan
         self.injections_done = []   # (Injection, rank, pid) applied
+        self._attempted = 0         # injections attempted (host kinds
+        #                             append one done-entry PER RANK)
         self._clock = clock
         self._sleep = sleep
         self._job = None
@@ -77,8 +86,8 @@ class ChaosMonkey:
             t.join(timeout=5.0)
 
     def done(self):
-        return len(self.injections_done) >= len(self.plan.injections) \
-            or self._stop.is_set()
+        return max(self._attempted, len(self.injections_done)) \
+            >= len(self.plan.injections) or self._stop.is_set()
 
     # -- scheduler ---------------------------------------------------------
 
@@ -93,6 +102,7 @@ class ChaosMonkey:
             if self._stop.is_set():
                 return
             self._apply(inj)
+            self._attempted += 1
         _log(f"plan complete: {len(self.injections_done)} injection(s) "
              f"applied")
 
@@ -104,11 +114,47 @@ class ChaosMonkey:
         return [(rank, p) for rank, p in enumerate(job.procs)
                 if p.poll() is None]
 
+    def _hostname(self, rank):
+        with self._lock:
+            job = self._job
+        slots = getattr(job, "slots", None)
+        if slots and rank < len(slots):
+            return slots[rank].hostname
+        return "local"  # no slot map: the whole job is one host
+
+    def _apply_host(self, inj, live):
+        """Host-granularity kinds: the draw picks a live HOST; every
+        live rank on it gets the signal."""
+        hosts = {}
+        for rank, proc in live:
+            hosts.setdefault(self._hostname(rank), []).append((rank, proc))
+        names = sorted(hosts)
+        target = names[inj.rank % len(names)]
+        sig = (signal.SIGKILL if inj.kind == "host_sigkill"
+               else signal.SIGTERM)
+        hit = []
+        for rank, proc in hosts[target]:
+            try:
+                if sig == signal.SIGKILL:
+                    proc.kill()
+                else:
+                    proc.send_signal(sig)
+            except OSError as e:
+                _log(f"{inj.kind} -> host {target} rank {rank}: {e}")
+                continue
+            self.injections_done.append(
+                (inj, rank, getattr(proc, "pid", None)))
+            hit.append(rank)
+        _log(f"t+{inj.at:.1f}s {inj.kind} -> host {target} "
+             f"(ranks {hit})")
+
     def _apply(self, inj):
         live = self._live_procs()
         if not live:
             _log(f"skip {inj.kind} at t+{inj.at:.1f}s: no live processes")
             return
+        if inj.kind in ("host_sigterm", "host_sigkill"):
+            return self._apply_host(inj, live)
         rank, proc = live[inj.rank % len(live)]
         try:
             if inj.kind == "sigterm":

@@ -2,8 +2,10 @@
 
 A plan is a list of :class:`Injection`\\ s — *when* (seconds since the
 monkey started), *what* (``sigterm`` / ``sigkill`` / ``stall`` /
-``slow_disk``), *whom* (a rank draw the injector maps onto the live
-processes with a modulo, so the plan does not need to know np), and for
+``slow_disk``, or the host-granularity ``host_sigterm`` /
+``host_sigkill``), *whom* (a rank draw the injector maps onto the live
+processes — or, for host kinds, onto the live *hosts* — with a modulo,
+so the plan does not need to know np), and for
 the pausing kinds, *how long*. Everything is derived from one
 ``random.Random(seed)``: the same spec always produces byte-identical
 schedules, which is what makes a chaos soak reproducible and a
@@ -27,7 +29,13 @@ import json
 import os
 import random
 
-KINDS = ("sigterm", "sigkill", "stall", "slow_disk")
+KINDS = ("sigterm", "sigkill", "stall", "slow_disk",
+         # host granularity: the draw picks a HOST and every rank on
+         # it gets the signal — a spot eviction (host_sigterm) or
+         # outright loss (host_sigkill) of a whole machine, which is
+         # how preemption actually arrives on multi-host pods
+         # (docs/SCALING.md)
+         "host_sigterm", "host_sigkill")
 
 _DEFAULTS = {"seed": 0, "interval": 5.0, "jitter": 0.5,
              "kinds": ("sigterm",), "count": 8, "duration": 2.0}

@@ -1,0 +1,36 @@
+"""Process-mesh subsystem: real multi-process ``jax.distributed`` jobs
+forming ONE logical mesh with DCN-aware tiers (docs/SCALING.md).
+
+``ensure_distributed`` is the single sanctioned
+``jax.distributed.initialize`` call site in the tree (HVD-DISTINIT
+lint pass); everything else here derives the global/(per-process
+addressable) split the rest of the framework was built against.
+"""
+
+from horovod_tpu.cluster.procmesh import (  # noqa: F401
+    assert_process_contiguous,
+    build_process_mesh,
+    coordinator_spec,
+    ensure_distributed,
+    global_batch,
+    is_multiprocess,
+    local_row_block,
+    mesh_tiers,
+    place,
+    process_grid,
+    shard_from_global,
+)
+
+__all__ = [
+    "assert_process_contiguous",
+    "build_process_mesh",
+    "coordinator_spec",
+    "ensure_distributed",
+    "global_batch",
+    "is_multiprocess",
+    "local_row_block",
+    "mesh_tiers",
+    "place",
+    "process_grid",
+    "shard_from_global",
+]

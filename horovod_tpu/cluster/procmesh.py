@@ -1,0 +1,370 @@
+"""The process mesh: one logical device mesh spanning N real
+``jax.distributed`` processes.
+
+Everything below ``horovod_tpu.init()`` — GspmdPlan, the ZeRO row
+keying, the ckpt v2 row-dict layout, the serve loader's N-host→
+M-device reshard — was built against a *global* device set with
+*per-process addressable shards*. This module is where that global
+view actually comes from in a multi-process job:
+
+* :func:`ensure_distributed` is the ONE sanctioned call site of
+  ``jax.distributed.initialize`` in the tree (ratcheted by the
+  HVD-DISTINIT lint pass): every process launched by
+  ``hvdrun --spmd-procs N`` joins the coordinator named by
+  ``HOROVOD_COORDINATOR_ADDR``, after which ``jax.devices()`` spans
+  the whole job and ``jax.local_devices()`` is this process's slice.
+* :func:`build_process_mesh` arranges that global device set as a 2-D
+  ``(dcn, data)`` grid, **ICI-first**: the minor (fastest-varying)
+  axis is the intra-process/intra-host device tier whose collectives
+  ride ICI, the outer axis is the process tier whose collectives ride
+  the data-center network. Row ``p`` of the grid is exactly process
+  ``p``'s local devices — so a batch sharded over ``(dcn, data)`` puts
+  a *contiguous* block of global rows on each process, which is the
+  same contract ``ckpt.sharded._owned_rows`` and the data loader's
+  ``rank/world`` sharding already assume.
+
+On CPU the multi-process data plane needs two things set **before the
+first backend touch**, both handled here: the gloo cross-process
+collectives implementation (without it XLA:CPU refuses multiprocess
+computations outright) and ``--xla_force_host_platform_device_count``
+so each process contributes ``HOROVOD_SPMD_LOCAL_DEVICES`` virtual
+chips — the test/bench stand-in for a real TPU host's 4–8 chips.
+"""
+
+import logging
+import os
+import threading
+
+import numpy as np
+
+from horovod_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
+
+logger = logging.getLogger("horovod_tpu")
+
+_lock = threading.Lock()
+_dist = {"joined": False, "spec": None}
+
+
+def _env_int(env, name, default=0):
+    v = env.get(name)
+    if v in (None, ""):
+        return default
+    return int(v)
+
+
+def coordinator_spec(cfg=None, env=None):
+    """The ``(address, num_processes, process_id)`` this process should
+    join, or ``None`` for single-process runs. Read from the hvdrun env
+    contract: ``HOROVOD_COORDINATOR_ADDR`` names the coordinator,
+    ``HOROVOD_SPMD_PROCS`` (default ``HOROVOD_SIZE``) the world, and
+    the process id is the launcher rank."""
+    env = os.environ if env is None else env
+    coord = env.get("HOROVOD_COORDINATOR_ADDR")
+    if not coord:
+        return None
+    if cfg is not None:
+        rank, size = cfg.rank, cfg.size
+        procs = getattr(cfg, "spmd_procs", 0) or size
+    else:
+        rank = _env_int(env, "HOROVOD_RANK", 0)
+        size = _env_int(env, "HOROVOD_SIZE", 1)
+        procs = _env_int(env, "HOROVOD_SPMD_PROCS", 0) or size
+    if procs <= 1:
+        return None
+    return (coord, procs, rank)
+
+
+def _backend_live():
+    """True once any jax backend is initialized in this process — the
+    point after which distributed init / device-count forcing is too
+    late."""
+    try:
+        from jax._src import xla_bridge
+        return xla_bridge.backends_are_initialized()
+    # hvd-lint: disable=HVD-EXCEPT -- internal-API probe across jax versions; False is safe
+    except Exception:  # pragma: no cover - internal API drift
+        return False
+
+
+def _foreign_distributed():
+    """True when something else already ran jax.distributed.initialize
+    in this process (a notebook, a framework wrapper)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client is not None
+    # hvd-lint: disable=HVD-EXCEPT -- internal-API probe across jax versions; False is safe
+    except Exception:  # pragma: no cover - internal API drift
+        return False
+
+
+def _force_local_device_count(n, env):
+    """Merge ``--xla_force_host_platform_device_count=n`` into
+    XLA_FLAGS (CPU-only flag; the TPU backend ignores it). User-set
+    values win, matching config.apply_xla_flags."""
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def ensure_distributed(cfg=None, env=None):
+    """Join the multi-process JAX runtime (idempotent).
+
+    The ONE sanctioned ``jax.distributed.initialize`` call site
+    (HVD-DISTINIT). Returns True when this process is part of a
+    multi-process job (whether joined now or on a prior call), False
+    for single-process runs.
+
+    Must run before the first backend touch: ``basics.init()`` calls
+    it right after ``apply_xla_flags`` for exactly that reason.
+    """
+    env = os.environ if env is None else env
+    spec = coordinator_spec(cfg, env)
+    with _lock:
+        if _dist["joined"]:
+            if spec is not None and spec != _dist["spec"]:
+                raise RuntimeError(
+                    f"jax.distributed already joined as {_dist['spec']} "
+                    f"but the environment now names {spec}; one process "
+                    "cannot re-join a different coordinator")
+            return _dist["spec"] is not None
+        if spec is None:
+            if _foreign_distributed():
+                # someone initialized jax.distributed before us (library
+                # embedding); adopt their world rather than fight it
+                _dist["joined"] = True
+                _dist["spec"] = ("<external>", None, None)
+                return True
+            return False
+        if _foreign_distributed():
+            _dist["joined"] = True
+            _dist["spec"] = ("<external>", None, None)
+            return True
+        if _backend_live():
+            raise RuntimeError(
+                "HOROVOD_COORDINATOR_ADDR is set but a jax backend was "
+                "already initialized in this process — "
+                "jax.distributed.initialize must run before any jax "
+                "computation. Call horovod_tpu.init() (or "
+                "cluster.ensure_distributed()) before touching jax.")
+        coord, procs, pid = spec
+
+        import jax
+
+        local = 0
+        if cfg is not None:
+            local = getattr(cfg, "spmd_local_devices", 0)
+        local = local or _env_int(env, "HOROVOD_SPMD_LOCAL_DEVICES", 0)
+        platforms = (env.get("JAX_PLATFORMS")
+                     or jax.config.jax_platforms or "")
+        cpu_only = platforms.replace("cpu", "").strip(", ") == "" and \
+            "cpu" in platforms
+        if local > 1:
+            if cpu_only:
+                _force_local_device_count(local, env)
+            else:  # pragma: no cover - TPU path
+                logger.warning(
+                    "HOROVOD_SPMD_LOCAL_DEVICES=%d ignored: only the CPU "
+                    "backend supports forced device counts", local)
+        if cpu_only:
+            # XLA:CPU refuses cross-process computations without a real
+            # collectives implementation; gloo is the in-tree one.
+            impl = None
+            if cfg is not None:
+                impl = getattr(cfg, "cpu_collectives", None)
+            impl = impl or env.get("HOROVOD_CPU_COLLECTIVES") or "gloo"
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=procs,
+            process_id=pid,
+        )
+        _dist["joined"] = True
+        _dist["spec"] = spec
+        logger.info("joined jax.distributed: coordinator=%s process %d/%d",
+                    coord, pid, procs)
+        return True
+
+
+def is_multiprocess():
+    """True when this process joined (or adopted) a multi-process
+    jax.distributed runtime via :func:`ensure_distributed`."""
+    with _lock:
+        return _dist["joined"] and _dist["spec"] is not None
+
+
+def process_grid(devices=None):
+    """The global device set as a ``(process, local_device)`` ndarray —
+    row ``p`` is process ``p``'s local devices in id order (ICI-first
+    minor axis). Raises when processes contribute unequal device
+    counts (a ragged grid cannot form a rectangular mesh)."""
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    by_proc = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    counts = {p: len(ds) for p, ds in by_proc.items()}
+    if len(set(counts.values())) != 1:
+        raise ValueError(
+            f"ragged process mesh: per-process device counts {counts}")
+    rows = [sorted(by_proc[p], key=lambda d: d.id)
+            for p in sorted(by_proc)]
+    return np.asarray(rows, dtype=object)
+
+
+def build_process_mesh(devices=None, axis_names=(DCN_AXIS, DATA_AXIS)):
+    """ONE logical mesh spanning every process of the job.
+
+    Axis order is ICI-first: ``axis_names[-1]`` (``data``) is the
+    intra-process tier — the minor, fastest-varying grid axis, so
+    collectives over it stay inside a host's ICI domain — and
+    ``axis_names[0]`` (``dcn``) is the process tier riding DCN.
+    Single-process device sets degrade to a 1-D ``(data,)`` mesh,
+    matching ``parallel.mesh.build_mesh``.
+    """
+    from jax.sharding import Mesh
+    grid = process_grid(devices)
+    if grid.shape[0] == 1:
+        return Mesh(grid.reshape(-1), (axis_names[-1],))
+    return Mesh(grid, axis_names)
+
+
+def mesh_tiers(mesh):
+    """The interconnect tier of every mesh axis, outer→inner:
+    ``[{"axis", "size", "tier", "scope"}]``. The ``dcn`` axis is the
+    inter-process/inter-host tier; everything else is an ICI tier
+    (intra-host on real TPU, virtual devices on the CPU stand-in)."""
+    procs = len({d.process_index for d in mesh.devices.flat})
+    out = []
+    for axis, size in zip(mesh.axis_names, mesh.devices.shape):
+        if axis == DCN_AXIS:
+            out.append({"axis": axis, "size": int(size), "tier": "dcn",
+                        "scope": f"inter-process ({procs} processes)"})
+        else:
+            out.append({"axis": axis, "size": int(size), "tier": "ici",
+                        "scope": "intra-process"})
+    return out
+
+
+def assert_process_contiguous(mesh):
+    """Checkpoint/loader row keying assumes each process owns a
+    contiguous block of global batch rows — true iff every outer-axis
+    row of the mesh grid lives on exactly one process and rows appear
+    in process order. Raise otherwise (a scrambled grid would silently
+    save rows under wrong global indices)."""
+    grid = mesh.devices
+    if grid.ndim == 1:
+        grid = grid.reshape(1, -1)
+    else:
+        grid = grid.reshape(grid.shape[0], -1)
+    procs = len({d.process_index for d in mesh.devices.flat})
+    if procs == 1:
+        return
+    last = -1
+    for r in range(grid.shape[0]):
+        owners = {d.process_index for d in grid[r]}
+        if len(owners) != 1:
+            raise ValueError(
+                f"process mesh row {r} spans processes {sorted(owners)}; "
+                "ckpt row ownership requires one process per dcn row")
+        owner = owners.pop()
+        if owner < last:
+            raise ValueError(
+                "process mesh rows out of process order; ckpt global row "
+                "indices would not be contiguous per process")
+        last = owner
+
+
+def local_row_block(global_rows, mesh=None):
+    """``(start, stop)`` of the contiguous global batch rows this
+    process feeds, for a batch sharded over all data axes of a
+    process-contiguous mesh. Mirrors ``ckpt.sharded._owned_rows``:
+    block ``p`` of ``process_count`` equal blocks."""
+    import jax
+    procs = jax.process_count()
+    pid = jax.process_index()
+    if mesh is not None:
+        assert_process_contiguous(mesh)
+    if global_rows % procs != 0:
+        raise ValueError(
+            f"global batch {global_rows} not divisible by process count "
+            f"{procs}")
+    per = global_rows // procs
+    return pid * per, (pid + 1) * per
+
+
+def global_batch(x, sharding, global_rows=None):
+    """Assemble a globally-sharded batch from this process's local
+    rows. Single-process: a plain ``device_put``. Multi-process: the
+    caller passes ONLY its own row block (``local_row_block``'s slice)
+    and the runtime stitches the global array from per-process
+    addressable shards — no process ever materializes the whole batch.
+    """
+    import jax
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    shape = (global_rows or x.shape[0] * jax.process_count(),) + \
+        tuple(x.shape[1:])
+    return jax.make_array_from_process_local_data(sharding, x, shape)
+
+
+def shard_from_global(x, sharding):
+    """The global array for ``sharding`` built from a full host copy of
+    the global value — with NO collectives. Under SPMD every process
+    computes the same host-side values (batches, init params, zero
+    buffers), so each process can slice out exactly the shards its own
+    devices address and stitch them together locally.
+
+    This matters because ``jax.device_put`` onto a sharding that spans
+    processes instead *broadcasts the entire value through the
+    collective fabric* to assert cross-process equality — per call. On
+    the gloo CPU transport those broadcasts interleave with the
+    compiled step's own async collectives and can mis-pair (message
+    size mismatch aborts), and on any transport they put the full batch
+    on the wire every step. Slicing locally costs a memcpy and cannot
+    race. The equality *check* device_put performed is forfeited: the
+    caller vouches that ``x`` is process-identical, which is the same
+    SPMD contract the rest of the program already rests on.
+    """
+    import jax
+    x = np.asarray(x)
+    indices = sharding.addressable_devices_indices_map(x.shape)
+    shards = [jax.device_put(x[idx], d) for d, idx in indices.items()]
+    return jax.make_array_from_single_device_arrays(
+        x.shape, sharding, shards)
+
+
+def place(x, sharding):
+    """Multi-process-safe ``device_put``: the one placement primitive
+    the framework's hot paths use (``training._placer``,
+    ``gspmd.place_state``).
+
+    * fully-addressable target (single process): plain device_put.
+    * cross-process target, ``x`` host-side or process-local (a numpy
+      batch, init params committed to one local device): hop via host
+      and ``shard_from_global`` — zero collectives. device_put would
+      instead broadcast the whole value through the fabric per leaf to
+      assert cross-process equality, which both costs the wire and can
+      mis-pair with the compiled step's own async collectives on gloo.
+    * ``x`` already a global array: device_put, which is a no-op when
+      the shardings match (every step after the first) and a true
+      fabric reshard when they don't.
+    """
+    import jax
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array):
+        if not x.is_fully_addressable or x.sharding == sharding:
+            return jax.device_put(x, sharding)
+        x = np.asarray(x)
+    return shard_from_global(x, sharding)
+
+
+def _reset_for_tests():
+    """Forget the joined-coordinator record (unit tests monkeypatch the
+    underlying initialize; a real joined runtime cannot be re-joined)."""
+    with _lock:
+        _dist["joined"] = False
+        _dist["spec"] = None

@@ -66,6 +66,16 @@ class Config:
     rendezvous_addr: str = None
     rendezvous_port: int = 0
 
+    # --- process mesh (hvdrun --spmd-procs; cluster/procmesh.py) ---
+    # number of jax.distributed processes forming the one logical mesh
+    # (0 = HOROVOD_SIZE when a coordinator address is set)
+    spmd_procs: int = 0
+    # virtual CPU devices this process contributes to the mesh (0 = the
+    # backend default; CPU-only, stands in for a TPU host's local chips)
+    spmd_local_devices: int = 0
+    # cross-process collectives impl for XLA:CPU (default "gloo")
+    cpu_collectives: str = None
+
     # --- data plane tuning ---
     fusion_threshold: int = DEFAULT_FUSION_THRESHOLD
     # Default collective wire format for DistributedOptimizer(
@@ -145,6 +155,9 @@ class Config:
             controller_port=_env_int("HOROVOD_CONTROLLER_PORT", 0),
             rendezvous_addr=_env_str("HOROVOD_GLOO_RENDEZVOUS_ADDR"),
             rendezvous_port=_env_int("HOROVOD_GLOO_RENDEZVOUS_PORT", 0),
+            spmd_procs=_env_int("HOROVOD_SPMD_PROCS", 0),
+            spmd_local_devices=_env_int("HOROVOD_SPMD_LOCAL_DEVICES", 0),
+            cpu_collectives=_env_str("HOROVOD_CPU_COLLECTIVES"),
             fusion_threshold=_env_int(
                 "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD),
             wire_dtype=_env_str("HOROVOD_WIRE_DTYPE"),

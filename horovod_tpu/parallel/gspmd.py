@@ -140,10 +140,19 @@ def place_state(plan, state):
     """``device_put`` ``state`` onto its plan shardings (no-op when
     already placed) — the GSPMD analogue of the explicit path's
     ``place_state``, and what a checkpoint restore feeds its
-    host-assembled tree through before stepping."""
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, s), state,
-        state_shardings(plan, state))
+    host-assembled tree through before stepping. Host or process-local
+    leaves headed for a multi-process mesh are sliced locally
+    (``cluster.procmesh.place``) rather than broadcast through the
+    fabric by device_put's cross-process equality assert."""
+    def _put(x, s):
+        if s.is_fully_addressable:
+            return jax.device_put(x, s)
+        from horovod_tpu.cluster import procmesh
+
+        return procmesh.place(x, s)
+
+    return jax.tree_util.tree_map(_put, state,
+                                  state_shardings(plan, state))
 
 
 def constrain(x, plan, spec):
@@ -379,6 +388,38 @@ def _shape_bytes(dtype, dims):
     return n * itemsize
 
 
+def _line_collective_bytes(line):
+    """``(op, nbytes)`` when the HLO line is a counted collective
+    instruction, else ``None`` — the one parser behind both the per-op
+    and the per-axis accounting."""
+    m = _HLO_RESULT_RE.search(line)
+    if m:
+        dtype, dims, op = m.group(1), m.group(2), m.group(3)
+        return op, _shape_bytes(dtype, dims)
+    t = _HLO_TUPLE_RE.search(line)
+    if not t:
+        return None
+    op = t.group(1)
+    head = line[:t.end(1)]
+    shapes = _HLO_SHAPE_RE.findall(head)
+    if t.group(2):
+        # async -start: (inputs..., outputs...) — keep the
+        # output half. collective-permute-start additionally
+        # carries trailing rank-0 unsigned context handles
+        # (u32[] tokens): strip those first, or the "half"
+        # would land on them and count ~0 payload. An
+        # unexpectedly odd tuple degrades to the final element
+        # rather than over-counting.
+        while (len(shapes) > 2 and shapes[-1][1] == ""
+               and shapes[-1][0] in ("u32", "s32", "u64",
+                                     "s64")):
+            shapes = shapes[:-1]
+        half = len(shapes) // 2
+        shapes = (shapes[half:] if half and not len(shapes) % 2
+                  else shapes[-1:])
+    return op, sum(_shape_bytes(d, dims) for d, dims in shapes)
+
+
 def collective_bytes_from_hlo(hlo_text):
     """Per-op collective byte/call totals of one compiled module, parsed
     from its optimized HLO text: ``{op: {"calls": n, "bytes": b}}``
@@ -388,36 +429,89 @@ def collective_bytes_from_hlo(hlo_text):
     schedule, so the module is what gets accounted."""
     out = {}
     for line in hlo_text.splitlines():
-        m = _HLO_RESULT_RE.search(line)
-        if m:
-            dtype, dims, op = m.group(1), m.group(2), m.group(3)
-            nbytes = _shape_bytes(dtype, dims)
-        else:
-            t = _HLO_TUPLE_RE.search(line)
-            if not t:
-                continue
-            op = t.group(1)
-            head = line[:t.end(1)]
-            shapes = _HLO_SHAPE_RE.findall(head)
-            if t.group(2):
-                # async -start: (inputs..., outputs...) — keep the
-                # output half. collective-permute-start additionally
-                # carries trailing rank-0 unsigned context handles
-                # (u32[] tokens): strip those first, or the "half"
-                # would land on them and count ~0 payload. An
-                # unexpectedly odd tuple degrades to the final element
-                # rather than over-counting.
-                while (len(shapes) > 2 and shapes[-1][1] == ""
-                       and shapes[-1][0] in ("u32", "s32", "u64",
-                                             "s64")):
-                    shapes = shapes[:-1]
-                half = len(shapes) // 2
-                shapes = (shapes[half:] if half and not len(shapes) % 2
-                          else shapes[-1:])
-            nbytes = sum(_shape_bytes(d, dims) for d, dims in shapes)
+        hit = _line_collective_bytes(line)
+        if hit is None:
+            continue
+        op, nbytes = hit
         slot = out.setdefault(op, {"calls": 0, "bytes": 0})
         slot["calls"] += 1
         slot["bytes"] += nbytes
+    return out
+
+
+# Which mesh TIER does each collective ride? The partitioner stamps
+# every collective with the participating device groups — explicit
+# (`replica_groups={{0,1},{2,3}}`), iota/v2
+# (`replica_groups=[2,4]<=[8]` with an optional `T(perm)` transpose),
+# or, for collective-permute, `source_target_pairs={{0,4},{4,0}}`.
+# Group members are LOGICAL partition ids, i.e. positions in the mesh's
+# row-major device grid — so the axes a group varies over are exactly
+# the mesh axes (ICI vs DCN tiers) its traffic rides.
+_HLO_EXPLICIT_GROUPS_RE = re.compile(
+    r"(?:replica_groups|source_target_pairs)=\{(\{[0-9, {}]*\})\}")
+_HLO_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+
+
+def _parse_device_groups(line):
+    """The collective's participating device-id groups, or ``None``
+    when the line carries no group annotation (single-device module)."""
+    m = _HLO_EXPLICIT_GROUPS_RE.search(line)
+    if m:
+        return [[int(x) for x in grp.split(",") if x.strip()]
+                for grp in re.findall(r"\{([0-9, ]*)\}", m.group(1))]
+    m = _HLO_IOTA_GROUPS_RE.search(line)
+    if m:
+        import numpy as _np
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = _np.arange(int(_np.prod(dims)))
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            ids = ids.reshape(dims).transpose(perm).reshape(-1)
+        return ids.reshape(n_groups, group_size).tolist()
+    return None
+
+
+def group_axes(groups, mesh):
+    """The mesh axes a collective's device groups span, in mesh axis
+    order — ``("data",)`` for an intra-host/ICI reduction, ``("dcn",)``
+    for the cross-process tier, both for a global collective. For
+    collective-permute pass the source→target pairs: the axes where
+    source and target coordinates differ are the wire the hop rides."""
+    shape = mesh.devices.shape
+    varies = [False] * len(shape)
+    import numpy as _np
+    for grp in groups:
+        coords = [_np.unravel_index(d, shape) for d in grp]
+        for ax in range(len(shape)):
+            if len({c[ax] for c in coords}) > 1:
+                varies[ax] = True
+    return tuple(a for a, v in zip(mesh.axis_names, varies) if v)
+
+
+def collective_axis_bytes_from_hlo(hlo_text, mesh):
+    """Per-mesh-tier collective byte totals of one compiled module:
+    ``{axis_label: {"calls", "bytes", "ops": {op: bytes}}}`` where the
+    label is ``"+"``-joined mesh axes (``"data"``, ``"dcn"``,
+    ``"dcn+data"`` for a global collective) and ``"replica"`` collects
+    instructions whose groups never leave one device (or carry no group
+    annotation). This is what prices a DCN tier separately from ICI in
+    the scaling sweep (bench_scaling.py / SCALING_*.json)."""
+    out = {}
+    for line in hlo_text.splitlines():
+        hit = _line_collective_bytes(line)
+        if hit is None:
+            continue
+        op, nbytes = hit
+        groups = _parse_device_groups(line)
+        axes = group_axes(groups, mesh) if groups else ()
+        label = "+".join(axes) if axes else "replica"
+        slot = out.setdefault(label, {"calls": 0, "bytes": 0, "ops": {}})
+        slot["calls"] += 1
+        slot["bytes"] += nbytes
+        slot["ops"][op] = slot["ops"].get(op, 0) + nbytes
     return out
 
 
@@ -434,10 +528,12 @@ class CompiledProgramCache:
     engine (``serve/engine.py``) both wrap it, so a fix to the key or
     the accounting semantics cannot miss a site."""
 
-    def __init__(self, prefix="spmd"):
+    def __init__(self, prefix="spmd", mesh=None):
         self.prefix = prefix
-        self._programs = {}  # signature -> (executable, collectives)
+        self.mesh = mesh  # set → per-axis (ICI/DCN tier) attribution too
+        self._programs = {}  # sig -> (executable, collectives, by_axis)
         self.last_collectives = None
+        self.last_axis_collectives = None
 
     @staticmethod
     def signature(args):
@@ -451,15 +547,20 @@ class CompiledProgramCache:
         entry = self._programs.get(key)
         if entry is None:
             compiled = jitted.lower(*args).compile()
+            by_axis = None
             try:
                 collectives = record_compiled_collectives(
                     compiled, prefix=self.prefix)
+                if self.mesh is not None:
+                    by_axis = collective_axis_bytes_from_hlo(
+                        compiled.as_text(), self.mesh)
             # hvd-lint: disable=HVD-EXCEPT -- HLO accounting must not kill a step
             except Exception:  # pragma: no cover — must not kill a step
                 collectives = {}
-            entry = (compiled, collectives)
+            entry = (compiled, collectives, by_axis)
             self._programs[key] = entry
         self.last_collectives = entry[1]
+        self.last_axis_collectives = entry[2]
         return entry[0]
 
 

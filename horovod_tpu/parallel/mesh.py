@@ -68,3 +68,18 @@ def data_axis_names(mesh=None):
     """All mesh axes that gradients are reduced over (data + dcn)."""
     mesh = mesh if mesh is not None else get_mesh()
     return tuple(a for a in mesh.axis_names if a in (DCN_AXIS, DATA_AXIS))
+
+
+def ici_axis_names(mesh=None):
+    """The intra-host (ICI) tier: every axis except ``dcn``. On a
+    process mesh (cluster/procmesh.py) these are the minor axes whose
+    collectives never leave a host."""
+    mesh = mesh if mesh is not None else get_mesh()
+    return tuple(a for a in mesh.axis_names if a != DCN_AXIS)
+
+
+def process_span(mesh=None):
+    """Number of distinct jax processes the mesh's devices live on
+    (1 for every single-process mesh, N under hvdrun --spmd-procs N)."""
+    mesh = mesh if mesh is not None else get_mesh()
+    return len({d.process_index for d in mesh.devices.flat})

@@ -155,6 +155,7 @@ class Job:
 
     def __init__(self):
         self.procs = []
+        self.slots = []  # Slot per proc (same order); chaos host targets
         self._failed = threading.Event()
         self.first_failure = None
         self.exit_codes = {}
@@ -296,6 +297,7 @@ def launch(slots, command, controller_addr, controller_port,
         job.procs.append(spawn(slot.hostname, command, env,
                                ssh_port=ssh_port, stdout=out,
                                middleman=middleman))
+        job.slots.append(slot)
     # fan out SIGINT/SIGTERM (only from the main thread of the CLI)
     if threading.current_thread() is threading.main_thread():
         def _forward(signum, frame):

@@ -89,6 +89,17 @@ def build_parser():
     p.add_argument("--jax-coordinator", action="store_true",
                    help="also start a jax.distributed coordinator so the "
                         "workers form one global TPU mesh")
+    p.add_argument("--spmd-procs", type=int, default=None, metavar="N",
+                   help="launch N real jax.distributed processes that "
+                        "form ONE logical (dcn, data) mesh spanning all "
+                        "of them (implies --jax-coordinator; defaults "
+                        "-np to N) — docs/SCALING.md")
+    p.add_argument("--spmd-local-devices", type=int, default=None,
+                   metavar="K",
+                   help="virtual CPU devices each --spmd-procs worker "
+                        "contributes to the mesh (sets "
+                        "HOROVOD_SPMD_LOCAL_DEVICES; the CPU stand-in "
+                        "for a TPU host's local chips)")
     p.add_argument("--network-interface", "--nic", dest="nic", default=None,
                    help="restrict control-plane traffic to this interface "
                         "(skips automatic interface discovery)")
@@ -206,6 +217,23 @@ def parse_args(argv=None):
         defaults = {a.dest: a.default for a in parser._actions}
         config_parser.load_config_file(args.config_file, args, defaults)
     args.elastic = _validate_elastic_args(parser, args)
+    if args.spmd_procs is not None:
+        if args.spmd_procs < 1:
+            parser.error(f"--spmd-procs must be >= 1 "
+                         f"(got {args.spmd_procs})")
+        if args.elastic:
+            parser.error("--spmd-procs is fixed-size: the "
+                         "jax.distributed world cannot resize mid-job; "
+                         "drop the elastic flags")
+        if args.num_proc is None:
+            args.num_proc = args.spmd_procs
+        elif args.num_proc != args.spmd_procs:
+            parser.error(f"--spmd-procs ({args.spmd_procs}) must equal "
+                         f"-np ({args.num_proc}): one jax.distributed "
+                         "process per launched rank")
+        args.jax_coordinator = True
+    elif args.spmd_local_devices is not None:
+        parser.error("--spmd-local-devices requires --spmd-procs")
     if args.chaos is not None:
         from horovod_tpu.chaos import parse_spec
         try:
@@ -509,6 +537,11 @@ def _run(args):
         jport = (free_port() if controller_addr == "127.0.0.1"
                  else random.randint(23000, 43000))
         extra_env["HOROVOD_COORDINATOR_ADDR"] = f"{controller_addr}:{jport}"
+    if args.spmd_procs is not None:
+        extra_env["HOROVOD_SPMD_PROCS"] = str(args.spmd_procs)
+        if args.spmd_local_devices:
+            extra_env["HOROVOD_SPMD_LOCAL_DEVICES"] = \
+                str(args.spmd_local_devices)
 
     _check_metrics_ports(args, slots)
     dump_dir, tmp_dump_dir = _flightrec_dir(args, extra_env)

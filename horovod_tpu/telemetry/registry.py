@@ -379,6 +379,12 @@ class MetricsRegistry:
             aliases = dict(self._aliases)
         lines = []
         for name, m in metrics:
+            if m.label_names and not m._each():
+                # A labelled family with no children yet would emit a
+                # HELP/TYPE header with zero sample lines — invalid for
+                # strict expfmt parsers. Unlabelled families always have
+                # their self-child, so they still render at zero.
+                continue
             if m.help:
                 lines.append("# HELP %s %s" % (
                     name, m.help.replace("\\", "\\\\").replace("\n", " ")))
@@ -387,6 +393,8 @@ class MetricsRegistry:
         for name, m in metrics:
             legacy = aliases.get(name)
             if legacy is None:
+                continue
+            if m.label_names and not m._each():
                 continue
             lines.append("# HELP %s DEPRECATED alias of %s; the "
                          "horovod_* names are removed next release"

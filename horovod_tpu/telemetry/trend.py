@@ -73,27 +73,60 @@ def _flatten(doc, prefix="", out=None):
     return out
 
 
+def _flatten_scaling(doc):
+    """The comparable series of one ``SCALING_*.json`` sweep
+    (bench_scaling.py): per-world efficiency, step time, per-chip
+    throughput and goodput, keyed ``scaling.<world>.<metric>`` so a
+    bent curve shows up as a regressed per-world point. ``efficiency``
+    carries no lower-is-better substring -> higher-is-better, exactly
+    right."""
+    out = {}
+    for world in doc.get("worlds", ()):
+        name = world.get("world")
+        if not name:
+            continue
+        prefix = f"scaling.{name}."
+        for key in ("efficiency", "img_per_sec_per_chip",
+                    "step_ms_median"):
+            if isinstance(world.get(key), (int, float)):
+                out[prefix + key] = float(world[key])
+        goodput = world.get("goodput") or {}
+        for key in ("ratio", "unattributed_frac"):
+            if isinstance(goodput.get(key), (int, float)):
+                out[f"{prefix}goodput.{key}"] = float(goodput[key])
+    return out
+
+
 def extract_metrics(doc):
     """The comparable numeric series of one round document (wrapper
-    unwrapped, nested goodput/serve blocks dotted in)."""
+    unwrapped, nested goodput/serve blocks dotted in; scaling sweeps
+    dotted per world)."""
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         doc = doc["parsed"]
     if not isinstance(doc, dict):
         return {}
+    if doc.get("bench") == "scaling" or "efficiency_curve" in doc:
+        return _flatten_scaling(doc)
     return _flatten(doc)
 
 
 def find_rounds(paths=None):
     """Resolve ``paths`` (files, dirs, or None for the repo root this
-    process runs in) to the sorted list of ``BENCH_*.json`` files —
-    name order IS round order (``BENCH_r01`` … ``BENCH_r09``)."""
+    process runs in) to the sorted list of ``BENCH_*.json`` then
+    ``SCALING_*.json`` files — name order IS round order
+    (``BENCH_r01`` … ``BENCH_r09``, ``SCALING_r01`` …). Scaling sweeps
+    sort after the bench rounds: their metric keys (``scaling.*``)
+    never collide with bench keys, so interleaving order between the
+    two families is irrelevant to the diff."""
     if not paths:
         paths = ["."]
     out = []
     for p in paths:
         if os.path.isdir(p):
+            root = glob.escape(p)
+            out.extend(sorted(glob.glob(os.path.join(root, "BENCH_*.json"))))
             out.extend(sorted(glob.glob(
-                os.path.join(glob.escape(p), "BENCH_*.json"))))
+                os.path.join(root, "SCALING_*.json"))))
         else:
             out.append(p)
     return out

@@ -81,6 +81,22 @@ def state_specs(state):
     return gspmd_lib.state_partition_specs(state)
 
 
+def _put(x, sharding):
+    """``device_put`` to ``sharding``, multi-process safe: host or
+    process-local values headed for a sharding that spans processes are
+    sliced locally (``cluster.procmesh.place``) instead of letting
+    device_put broadcast the whole value through the collective fabric
+    to assert cross-process equality — that broadcast runs per call,
+    per leaf, and on the gloo CPU transport it can mis-pair with the
+    step's own async collectives. Already-global arrays keep the plain
+    device_put (no-op when already placed)."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    from horovod_tpu.cluster import procmesh
+
+    return procmesh.place(x, sharding)
+
+
 def _placer(mesh, spec):
     """device_put to a stable NamedSharding (no-op when already placed).
 
@@ -95,13 +111,13 @@ def _placer(mesh, spec):
 
         def place(tree):
             return jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, sharding), tree)
+                lambda x: _put(x, sharding), tree)
 
         return place
 
     def place(tree):
         return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(
+            lambda x, s: _put(
                 x, jax.sharding.NamedSharding(mesh, s)), tree, spec)
 
     return place
@@ -705,8 +721,9 @@ class _SpmdProgram:
         self._donate = donate
         self.jitted = None
         self.state_shardings = None
-        self._cache = gspmd_lib.CompiledProgramCache()
+        self._cache = gspmd_lib.CompiledProgramCache(mesh=plan.mesh)
         self.compiled_collectives = None
+        self.compiled_axis_collectives = None
 
     def jitted_for(self, placed_state):
         from horovod_tpu.parallel import gspmd as gspmd_lib
@@ -741,6 +758,8 @@ class _SpmdProgram:
         into every executable."""
         ex = self._cache.executable(self.jitted_for(placed[0]), placed)
         self.compiled_collectives = self._cache.last_collectives
+        self.compiled_axis_collectives = \
+            self._cache.last_axis_collectives
         return ex
 
     def lower(self, placed):
@@ -1036,9 +1055,8 @@ def _make_spmd_train_step(model, tx, mesh=None,
         # once the program is built, its cached shardings tree is
         # reused instead of re-deriving specs on every step
         if prog.state_shardings is not None:
-            return jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(x, s), state,
-                prog.state_shardings)
+            return jax.tree_util.tree_map(_put, state,
+                                          prog.state_shardings)
         return gspmd_lib.place_state(plan, state)
 
     if loader is not None:
@@ -1122,7 +1140,7 @@ def _make_spmd_train_step(model, tx, mesh=None,
         ag = [jnp.zeros((w, size_or_zero(i, s)), jnp.float32)
               for i, s in enumerate(schedule.shard_sizes)]
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, plan.sharding(wire_spec)),
+            lambda x: _put(x, plan.sharding(wire_spec)),
             {"rs": rs, "ag": ag})
 
     def _wire_state(state):
@@ -1157,6 +1175,7 @@ def _make_spmd_train_step(model, tx, mesh=None,
         ex = prog.executable(placed)  # one compile per shape signature
         step.jitted = prog.jitted
         step.compiled_collectives = prog.compiled_collectives
+        step.compiled_axis_collectives = prog.compiled_axis_collectives
         t0 = _time.perf_counter()
         try:
             outs = ex(*placed)
@@ -1220,6 +1239,7 @@ def _make_spmd_train_step(model, tx, mesh=None,
     step.plan = plan
     step.spmd = True
     step.compiled_collectives = None  # set at first call
+    step.compiled_axis_collectives = None
     step._settles_ledger = True
     step.xray = xray
     return step
@@ -1354,9 +1374,8 @@ def _make_spmd_lm_train_step(model, tx, mesh=None, batch_axis="data",
 
     def place_state(state):
         if prog.state_shardings is not None:
-            return jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(x, s), state,
-                prog.state_shardings)
+            return jax.tree_util.tree_map(_put, state,
+                                          prog.state_shardings)
         return gspmd_lib.place_state(plan, state)
 
     prog = _SpmdProgram(plan, global_step, arg_specs=(token_spec,),
@@ -1376,6 +1395,7 @@ def _make_spmd_lm_train_step(model, tx, mesh=None, batch_axis="data",
         ex = prog.executable(placed)  # one compile per shape signature
         step.jitted = prog.jitted
         step.compiled_collectives = prog.compiled_collectives
+        step.compiled_axis_collectives = prog.compiled_axis_collectives
         out = ex(*placed)
         _flightrec.step_end(n)
         ledger = _ledger_lib.get_ledger()
@@ -1404,6 +1424,7 @@ def _make_spmd_lm_train_step(model, tx, mesh=None, batch_axis="data",
     step.plan = plan
     step.spmd = True
     step.compiled_collectives = None
+    step.compiled_axis_collectives = None
     step._settles_ledger = True
     step.xray = xray
     return step

@@ -1,0 +1,154 @@
+"""Worker process of the tier-1 multi-process e2e
+(tests/test_multiprocess.py): one rank of a real 2-process
+``jax.distributed`` job on the CPU stand-in (gloo collectives, forced
+local device count).
+
+The deterministic workload lives HERE — the test process imports this
+module to run the very same functions single-process, so the oracle
+and the multi-process run can only differ by the process mesh.
+
+Modes (``--mode``):
+
+* ``train``    — N GSPMD steps on the process mesh; rank 0 writes
+  ``losses.json``. Flight-recorder env makes every rank drop a
+  ``goodput.rank<r>.json`` at shutdown.
+* ``save``     — train N steps, then every rank saves its shard of the
+  state (``ckpt.sharded``, rank/world keyed); rank 0 also writes
+  ``reference.npz``, the full host state for bitwise comparison.
+* ``restore``  — restore a checkpoint (written by ANY world size) into
+  a fresh state; rank 0 writes ``restored.npz``.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+GLOBAL_ROWS = 8
+N_FEATURES = 16
+N_CLASSES = 3
+
+
+def build_batch():
+    """The deterministic global batch — identical on every process."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(GLOBAL_ROWS, N_FEATURES)).astype(np.float32)
+    y = rng.randint(0, N_CLASSES, size=(GLOBAL_ROWS,)).astype(np.int32)
+    return x, y
+
+
+def build_state_and_step(mesh):
+    """Model, optimizer, init state and the compiled GSPMD step — one
+    construction shared by worker ranks and the in-test oracle."""
+    import jax
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import training
+    from horovod_tpu.models.simple import MLP
+
+    model = MLP(features=(8, N_CLASSES))
+    tx = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9))
+    x, _ = build_batch()
+    state = training.create_train_state(model, tx, jax.random.PRNGKey(0),
+                                        x[:1])
+    step = training.make_train_step(model, tx, mesh=mesh, donate=False,
+                                    spmd=True)
+    return state, step
+
+
+def train_steps(mesh, steps):
+    state, step = build_state_and_step(mesh)
+    x, y = build_batch()
+    losses = []
+    for _ in range(steps):
+        state, loss = step(state, x, y)
+        losses.append(float(loss))
+    return state, losses
+
+
+def host_state(state):
+    """The full state tree as host numpy — every leaf is replicated or
+    addressable-row-0-complete, so ``addressable_data(0)`` has the
+    whole value on every process."""
+    import jax
+    import numpy as np
+
+    def fetch(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(fetch, state)
+
+
+def flat_arrays(tree):
+    """``{leaf_path: ndarray}`` for npz round-trips."""
+    import jax
+    import numpy as np
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[jax.tree_util.keystr(path)] = np.asarray(leaf)
+    return flat
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", required=True,
+                   choices=("train", "save", "restore"))
+    p.add_argument("--out", required=True)
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--ckpt-step", type=int, default=None)
+    args = p.parse_args()
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    hvd.init()
+
+    import jax
+    mesh = hvd.mesh()
+    rank = int(jax.process_index())
+
+    if args.mode == "train":
+        state, losses = train_steps(mesh, args.steps)
+        if rank == 0:
+            with open(os.path.join(args.out, "losses.json"), "w") as f:
+                json.dump({"losses": losses,
+                           "procs": int(jax.process_count()),
+                           "devices": int(jax.device_count()),
+                           "mesh_axes": list(mesh.axis_names)}, f)
+    elif args.mode == "save":
+        from horovod_tpu.ckpt import sharded
+        state, losses = train_steps(mesh, args.steps)
+        host = host_state(state)
+        sharded.save_sharded(
+            os.path.join(args.out, "ckpt"), args.steps, host,
+            rank=rank, world=int(jax.process_count()))
+        if rank == 0:
+            np.savez(os.path.join(args.out, "reference.npz"),
+                     **flat_arrays(host))
+            with open(os.path.join(args.out, "losses.json"), "w") as f:
+                json.dump({"losses": losses}, f)
+    else:  # restore
+        from horovod_tpu.ckpt import sharded
+        state, _step = build_state_and_step(mesh)
+        step_no, tree, _meta = sharded.restore_sharded(
+            os.path.join(args.out, "ckpt"), host_state(state),
+            step=args.ckpt_step)
+        if rank == 0:
+            np.savez(os.path.join(args.out, "restored.npz"),
+                     **flat_arrays(tree))
+            with open(os.path.join(args.out, "restored_step.json"),
+                      "w") as f:
+                json.dump({"step": int(step_no)}, f)
+
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -424,6 +424,53 @@ def test_mesh_flags_pmap_but_not_shim_layers(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD-DISTINIT
+
+
+def test_distinit_flags_rogue_initialize_but_not_the_entry_point(
+        tmp_path):
+    pkg = tmp_path / "horovod_tpu"
+    cluster = pkg / "cluster"
+    cluster.mkdir(parents=True)
+    (pkg / "rogue.py").write_text(
+        "import jax\n"
+        "jax.distributed.initialize(coordinator_address='h:1',\n"
+        "                           num_processes=2, process_id=0)\n")
+    (cluster / "procmesh.py").write_text(
+        "import jax\n"
+        "def ensure_distributed():\n"
+        "    jax.distributed.initialize()\n")
+    r = run_lint([str(pkg)], root=str(tmp_path))
+    hits = [f for f in r.findings if f.rule == "HVD-DISTINIT"]
+    assert [f.file for f in hits] == \
+        [os.path.join("horovod_tpu", "rogue.py")]
+    assert "ensure_distributed" in hits[0].hint
+
+
+def test_distinit_negative_other_initializers(tmp_path):
+    pkg = tmp_path / "horovod_tpu"
+    pkg.mkdir()
+    (pkg / "fine.py").write_text(
+        "import logging\n"
+        "def setup(app, dist):\n"
+        "    logging.initialize()\n"        # wrong receiver
+        "    app.distributed.configure()\n"  # wrong method
+        "    dist.initialize()\n")           # receiver not 'distributed'
+    r = run_lint([str(pkg)], root=str(tmp_path))
+    assert [f for f in r.findings if f.rule == "HVD-DISTINIT"] == []
+
+
+def test_distinit_catches_aliased_module_attribute(tmp_path):
+    pkg = tmp_path / "horovod_tpu"
+    pkg.mkdir()
+    (pkg / "sneaky.py").write_text(
+        "from jax import distributed\n"
+        "distributed.initialize(num_processes=2)\n")
+    r = run_lint([str(pkg)], root=str(tmp_path))
+    assert [f.rule for f in r.findings] == ["HVD-DISTINIT"]
+
+
+# ---------------------------------------------------------------------------
 # HVD-METRIC (fixture project tree)
 
 

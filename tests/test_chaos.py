@@ -250,6 +250,129 @@ def test_monkey_stop_aborts_pending_injections():
 
 
 # ---------------------------------------------------------------------------
+# host-granularity targeting: one draw fells EVERY rank of one host
+# ---------------------------------------------------------------------------
+
+class FakeSlot:
+    def __init__(self, hostname):
+        self.hostname = hostname
+
+
+class FakeHostJob(FakeJob):
+    """A job whose rank->host map says ranks share machines, the same
+    shape run/launcher.py publishes via ``Job.slots``."""
+
+    def __init__(self, hostnames, pid0=100):
+        super().__init__(len(hostnames), pid0=pid0)
+        self.slots = [FakeSlot(h) for h in hostnames]
+
+
+def _host_monkey(job):
+    monkey = ChaosMonkey(ChaosPlan([]), clock=lambda: 0.0,
+                         sleep=lambda dt: None)
+    monkey._job = job  # targeting unit test: no scheduler thread
+    return monkey
+
+
+def test_monkey_host_sigterm_fells_whole_host_and_only_that_host():
+    """The draw picks a HOST, not a rank: every rank co-resident on it
+    is signalled, ranks on other hosts are untouched."""
+    job = FakeHostJob(["node-a", "node-a", "node-b", "node-b"])
+    monkey = _host_monkey(job)
+    monkey._apply(Injection(at=0.0, kind="host_sigterm", rank=0))
+    # sorted hosts [node-a, node-b], draw 0 -> node-a == ranks 0 and 1
+    assert job.procs[0].signals == [signal.SIGTERM]
+    assert job.procs[1].signals == [signal.SIGTERM]
+    assert job.procs[2].signals == []
+    assert job.procs[3].signals == []
+    # one injection, one done-entry PER felled rank
+    done = [(rank, pid) for _inj, rank, pid in monkey.injections_done]
+    assert done == [(0, 100), (1, 101)]
+
+
+def test_monkey_host_sigkill_uses_kill():
+    job = FakeHostJob(["node-a", "node-a", "node-b", "node-b"])
+    monkey = _host_monkey(job)
+    monkey._apply(Injection(at=0.0, kind="host_sigkill", rank=1))
+    # draw 1 over sorted [node-a, node-b] -> node-b
+    assert job.procs[2].signals == [signal.SIGKILL]
+    assert job.procs[3].signals == [signal.SIGKILL]
+    assert job.procs[2].rc == -9 and job.procs[3].rc == -9
+    assert job.procs[0].signals == [] and job.procs[1].signals == []
+
+
+def test_monkey_host_kind_skips_already_dead_ranks():
+    job = FakeHostJob(["node-a", "node-a"])
+    job.procs[0].rc = -9  # already a corpse
+    monkey = _host_monkey(job)
+    monkey._apply(Injection(at=0.0, kind="host_sigterm", rank=0))
+    assert job.procs[0].signals == []
+    assert job.procs[1].signals == [signal.SIGTERM]
+    assert [rank for _i, rank, _p in monkey.injections_done] == [1]
+
+
+def test_monkey_host_kind_without_slots_is_one_local_host():
+    """No slot map (plain local launch): the whole job counts as one
+    host, so a host kind fells every live rank."""
+    job = FakeJob(3)
+    monkey = _host_monkey(job)
+    monkey._apply(Injection(at=0.0, kind="host_sigterm", rank=0))
+    assert all(p.signals == [signal.SIGTERM] for p in job.procs)
+    assert [rank for _i, rank, _p in monkey.injections_done] == [0, 1, 2]
+
+
+def test_monkey_host_injection_counts_once_toward_done():
+    """A single host injection appends one done-entry per felled rank;
+    done() must still see ONE plan item consumed, not wait forever nor
+    claim completion early."""
+    now = {"t": 0.0}
+    plan = ChaosPlan([
+        Injection(at=10.0, kind="host_sigterm", rank=0),
+        Injection(at=20.0, kind="host_sigkill", rank=1)])
+    job = FakeHostJob(["node-a", "node-a", "node-b", "node-b"])
+    monkey = ChaosMonkey(plan, clock=lambda: now["t"],
+                         sleep=lambda dt: now.__setitem__(
+                             "t", now["t"] + dt))
+    monkey.attach(job)
+    assert _wait_until(monkey.done)
+    monkey.stop()
+    kinds = [(inj.kind, rank)
+             for inj, rank, _pid in monkey.injections_done]
+    assert kinds == [("host_sigterm", 0), ("host_sigterm", 1),
+                     ("host_sigkill", 2), ("host_sigkill", 3)]
+
+
+def test_blacklist_host_drain_is_not_a_crash():
+    """The elastic contract behind host chaos: a host whose eviction
+    was ANNOUNCED departs via record_drain — observable, zero penalty —
+    while an unannounced death backs the host off and eventually
+    blacklists it. Chaos host kills must read as the former when the
+    preempt announcement lands first (driver.py keys both on the
+    hostname, not the rank)."""
+    from horovod_tpu.elastic.driver import Blacklist
+
+    now = {"t": 0.0}
+    bl = Blacklist(threshold=3, base_delay=5.0,
+                   clock=lambda: now["t"])
+    # drained host: any number of planned departures, never excluded
+    for _ in range(5):
+        bl.record_drain("node-a")
+    assert bl.drains("node-a") == 5
+    assert bl.count("node-a") == 0
+    assert not bl.excluded("node-a")
+    # crashed host: first failure opens a backoff window...
+    bl.record_failure("node-b")
+    assert bl.excluded("node-b") and not bl.blacklisted("node-b")
+    # ...and reaching the threshold excludes it permanently
+    bl.record_failure("node-b")
+    bl.record_failure("node-b")
+    assert bl.blacklisted("node-b")
+    now["t"] = 10_000.0
+    assert bl.excluded("node-b")      # permanent: no cooldown escape
+    assert not bl.excluded("node-a")  # drained host still schedulable
+
+
+# ---------------------------------------------------------------------------
 # the np=3 soak: hvdrun --chaos against a live elastic CPU-mesh job
 # ---------------------------------------------------------------------------
 

@@ -458,6 +458,7 @@ def test_two_rank_injected_stall_attribution(monkeypatch, tmp_path,
     import optax
 
     import horovod_tpu as hvd_mod
+    from horovod_tpu.diag.doctor import doctor_cli
 
     # warm the compile caches with the identical step shape so the
     # measured runs' compile phase stays small relative to the injected
@@ -466,45 +467,64 @@ def test_two_rank_injected_stall_attribution(monkeypatch, tmp_path,
     warm_dir.mkdir()
     _attribution_run(monkeypatch, tmp_path, 0, 2, str(warm_dir))
 
-    dump_dir = tmp_path / "dumps"
-    dump_dir.mkdir()
-    for rank in (0, 1):
-        _attribution_run(monkeypatch, tmp_path, rank, 2, str(dump_dir))
-
-    dumps, skipped = report_mod.load_dumps(str(dump_dir))
-    assert sorted(dumps) == [0, 1], f"missing dumps (skipped={skipped})"
-    report = report_mod.aggregate(dumps)
-
     injected_data = N_STEPS * DATA_DELAY_S
     injected_ckpt = N_SAVES * CKPT_SLEEP_S
-    for rank in (0, 1):
-        phases = report["ranks"][rank]["phases"]
-        assert phases["data_wait"] == pytest.approx(injected_data,
-                                                    rel=0.20), \
-            f"rank {rank} data_wait {phases['data_wait']:.3f}s vs " \
-            f"injected {injected_data:.3f}s"
-        assert phases["ckpt_stall"] == pytest.approx(injected_ckpt,
-                                                     rel=0.20), \
-            f"rank {rank} ckpt_stall {phases['ckpt_stall']:.3f}s vs " \
-            f"injected {injected_ckpt:.3f}s"
-        # every second explained: the dump was written after a final
-        # settle, so the unattributed tail is ~nothing
-        assert report["ranks"][rank]["unattributed_seconds"] < \
-            0.02 * report["ranks"][rank]["wall_seconds"] + 1e-6
 
-    # the dominant sink is the injected data stall, fleet-wide and on
-    # both ranks — and hvd-doctor perf says so
-    assert report["fleet"]["dominant_sink"] == "data_wait"
-    for rank in (0, 1):
-        assert report["ranks"][rank]["dominant_sink"] == "data_wait"
-    from horovod_tpu.diag.doctor import doctor_cli
-    assert doctor_cli(["perf", str(dump_dir)]) == 0
-    out = capsys.readouterr().out
-    assert "DOMINANT TIME SINK (fleet): data_wait" in out
-    # dumps are self-describing (satellite: hvd_build_info)
-    bi = report["ranks"][0]["build_info"]
-    assert bi and set(bi) == {"version", "jax", "backend", "world"}
-    assert bi["world"] == "2"
+    # The timing bounds (±20% on the injected stalls, <2% unattributed)
+    # flake under CPU contention on the single-core CI box; retry the
+    # measured run up to 3× with fresh dirs — the structural asserts
+    # (both dumps present, self-describing build_info, doctor exits 0)
+    # hold unconditionally on every attempt, only the timing bounds may
+    # send us around again (same pattern as test_ckpt.py's async-save
+    # stall bound).
+    timing_failures = []
+    for attempt in range(3):
+        base = tmp_path / f"try{attempt}"
+        base.mkdir()
+        dump_dir = base / "dumps"
+        dump_dir.mkdir()
+        for rank in (0, 1):
+            _attribution_run(monkeypatch, base, rank, 2, str(dump_dir))
+
+        dumps, skipped = report_mod.load_dumps(str(dump_dir))
+        assert sorted(dumps) == [0, 1], \
+            f"missing dumps (skipped={skipped})"
+        report = report_mod.aggregate(dumps)
+        assert doctor_cli(["perf", str(dump_dir)]) == 0
+        out = capsys.readouterr().out
+        # dumps are self-describing (satellite: hvd_build_info)
+        bi = report["ranks"][0]["build_info"]
+        assert bi and set(bi) == {"version", "jax", "backend", "world"}
+        assert bi["world"] == "2"
+
+        try:
+            for rank in (0, 1):
+                phases = report["ranks"][rank]["phases"]
+                assert phases["data_wait"] == pytest.approx(
+                    injected_data, rel=0.20), \
+                    f"rank {rank} data_wait {phases['data_wait']:.3f}s " \
+                    f"vs injected {injected_data:.3f}s"
+                assert phases["ckpt_stall"] == pytest.approx(
+                    injected_ckpt, rel=0.20), \
+                    f"rank {rank} ckpt_stall {phases['ckpt_stall']:.3f}s " \
+                    f"vs injected {injected_ckpt:.3f}s"
+                # every second explained: the dump was written after a
+                # final settle, so the unattributed tail is ~nothing
+                assert report["ranks"][rank]["unattributed_seconds"] < \
+                    0.02 * report["ranks"][rank]["wall_seconds"] + 1e-6
+            # the dominant sink is the injected data stall, fleet-wide
+            # and on both ranks — and hvd-doctor perf says so
+            assert report["fleet"]["dominant_sink"] == "data_wait"
+            for rank in (0, 1):
+                assert report["ranks"][rank]["dominant_sink"] == \
+                    "data_wait"
+            assert "DOMINANT TIME SINK (fleet): data_wait" in out
+            return  # timing bounds held
+        except AssertionError as e:
+            timing_failures.append(f"attempt {attempt}: {e}")
+
+    pytest.fail("timing attribution out of bounds on 3 attempts:\n"
+                + "\n".join(timing_failures))
 
 
 # ---------------------------------------------------------------------------

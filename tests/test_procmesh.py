@@ -1,0 +1,396 @@
+"""Unit tests for the process-mesh subsystem (cluster/procmesh):
+coordinator-spec env gating, ensure_distributed idempotence, the
+(process, local_device) grid and its ICI-first mesh, the contiguous
+row-block contract the ckpt/loader paths key on, collective-free
+placement, and the per-axis HLO collective attribution that prices the
+DCN tier separately from ICI in SCALING_*.json.
+
+Everything here is single-process: multi-process jax.distributed
+behaviour is monkeypatched at the seams (fake devices with a
+``process_index``, a recorded ``initialize``); the real 2-process
+end-to-end contract lives in tests/test_multiprocess.py.
+"""
+
+import types
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.cluster import procmesh
+from horovod_tpu.parallel import gspmd as gspmd_lib
+from horovod_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
+
+
+class FakeDevice:
+    """The two attributes procmesh reads off a jax device."""
+
+    def __init__(self, device_id, process_index):
+        self.id = device_id
+        self.process_index = process_index
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"d{self.id}@p{self.process_index}"
+
+
+def _fake_devices(procs, local):
+    return [FakeDevice(p * local + i, p)
+            for p in range(procs) for i in range(local)]
+
+
+# ---------------------------------------------------------------------------
+# coordinator_spec: the hvdrun env contract
+# ---------------------------------------------------------------------------
+
+class TestCoordinatorSpec:
+    def test_no_coordinator_means_single_process(self):
+        assert procmesh.coordinator_spec(env={}) is None
+
+    def test_world_of_one_means_single_process(self):
+        env = {"HOROVOD_COORDINATOR_ADDR": "127.0.0.1:7777",
+               "HOROVOD_SPMD_PROCS": "1"}
+        assert procmesh.coordinator_spec(env=env) is None
+
+    def test_spec_from_env(self):
+        env = {"HOROVOD_COORDINATOR_ADDR": "127.0.0.1:7777",
+               "HOROVOD_SPMD_PROCS": "4", "HOROVOD_RANK": "2"}
+        assert procmesh.coordinator_spec(env=env) == \
+            ("127.0.0.1:7777", 4, 2)
+
+    def test_procs_defaults_to_world_size(self):
+        env = {"HOROVOD_COORDINATOR_ADDR": "h0:1234",
+               "HOROVOD_SIZE": "2", "HOROVOD_RANK": "1"}
+        assert procmesh.coordinator_spec(env=env) == ("h0:1234", 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# ensure_distributed: the ONE initialize call site, idempotent
+# ---------------------------------------------------------------------------
+
+class _DistStub:
+    """Recorded seams of ensure_distributed: initialize calls and
+    jax.config updates. Patching ``jax.config.update`` matters beyond
+    bookkeeping — the real call would set the gloo CPU collectives
+    implementation in THIS process, and every later backend init in
+    the test session would then demand a distributed client."""
+
+    def __init__(self):
+        self.init_calls = []
+        self.config_updates = []
+
+
+@pytest.fixture
+def dist_stub(monkeypatch):
+    procmesh._reset_for_tests()
+    stub = _DistStub()
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: stub.init_calls.append(kw))
+    monkeypatch.setattr(jax.config, "update",
+                        lambda k, v: stub.config_updates.append((k, v)))
+    monkeypatch.setattr(procmesh, "_backend_live", lambda: False)
+    monkeypatch.setattr(procmesh, "_foreign_distributed", lambda: False)
+    yield stub
+    procmesh._reset_for_tests()
+
+
+class TestEnsureDistributed:
+    def test_single_process_is_a_noop(self, dist_stub):
+        assert procmesh.ensure_distributed(env={}) is False
+        assert dist_stub.init_calls == []
+        assert procmesh.is_multiprocess() is False
+
+    def test_joins_once_then_remembers(self, dist_stub):
+        env = {"HOROVOD_COORDINATOR_ADDR": "127.0.0.1:7777",
+               "HOROVOD_SPMD_PROCS": "2", "HOROVOD_RANK": "0",
+               "JAX_PLATFORMS": "cpu"}
+        assert procmesh.ensure_distributed(env=env) is True
+        assert procmesh.ensure_distributed(env=env) is True
+        assert dist_stub.init_calls == [
+            {"coordinator_address": "127.0.0.1:7777",
+             "num_processes": 2, "process_id": 0}]
+        assert ("jax_cpu_collectives_implementation", "gloo") in \
+            dist_stub.config_updates
+        assert procmesh.is_multiprocess() is True
+
+    def test_rejoining_a_different_coordinator_raises(self, dist_stub):
+        env = {"HOROVOD_COORDINATOR_ADDR": "127.0.0.1:7777",
+               "HOROVOD_SPMD_PROCS": "2", "HOROVOD_RANK": "0",
+               "JAX_PLATFORMS": "cpu"}
+        procmesh.ensure_distributed(env=env)
+        env["HOROVOD_COORDINATOR_ADDR"] = "127.0.0.1:8888"
+        with pytest.raises(RuntimeError, match="cannot re-join"):
+            procmesh.ensure_distributed(env=env)
+
+    def test_live_backend_with_coordinator_raises(
+            self, dist_stub, monkeypatch):
+        monkeypatch.setattr(procmesh, "_backend_live", lambda: True)
+        env = {"HOROVOD_COORDINATOR_ADDR": "127.0.0.1:7777",
+               "HOROVOD_SPMD_PROCS": "2", "HOROVOD_RANK": "0"}
+        with pytest.raises(RuntimeError, match="already initialized"):
+            procmesh.ensure_distributed(env=env)
+
+    def test_foreign_init_is_adopted(self, dist_stub, monkeypatch):
+        monkeypatch.setattr(procmesh, "_foreign_distributed",
+                            lambda: True)
+        assert procmesh.ensure_distributed(env={}) is True
+        assert dist_stub.init_calls == []  # adopted, not re-initialized
+        assert procmesh.is_multiprocess() is True
+
+    def test_cpu_device_count_merged_into_xla_flags(self, dist_stub):
+        env = {"HOROVOD_COORDINATOR_ADDR": "h:1", "HOROVOD_SPMD_PROCS":
+               "2", "HOROVOD_RANK": "0", "JAX_PLATFORMS": "cpu",
+               "HOROVOD_SPMD_LOCAL_DEVICES": "4"}
+        procmesh.ensure_distributed(env=env)
+        assert "--xla_force_host_platform_device_count=4" in \
+            env["XLA_FLAGS"]
+
+    def test_user_set_device_count_wins(self, dist_stub):
+        env = {"HOROVOD_COORDINATOR_ADDR": "h:1", "HOROVOD_SPMD_PROCS":
+               "2", "HOROVOD_RANK": "0", "JAX_PLATFORMS": "cpu",
+               "HOROVOD_SPMD_LOCAL_DEVICES": "4",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        procmesh.ensure_distributed(env=env)
+        assert env["XLA_FLAGS"] == \
+            "--xla_force_host_platform_device_count=8"
+
+
+# ---------------------------------------------------------------------------
+# process_grid / build_process_mesh / tiers / contiguity
+# ---------------------------------------------------------------------------
+
+class TestProcessGrid:
+    def test_rows_are_processes_in_id_order(self):
+        grid = procmesh.process_grid(_fake_devices(2, 4))
+        assert grid.shape == (2, 4)
+        assert [[d.id for d in row] for row in grid] == \
+            [[0, 1, 2, 3], [4, 5, 6, 7]]
+        assert [{d.process_index for d in row} for row in grid] == \
+            [{0}, {1}]
+
+    def test_shuffled_input_still_sorts(self):
+        devs = _fake_devices(2, 2)
+        grid = procmesh.process_grid(devs[::-1])
+        assert [[d.id for d in row] for row in grid] == [[0, 1], [2, 3]]
+
+    def test_ragged_process_counts_raise(self):
+        devs = _fake_devices(2, 2) + [FakeDevice(9, 1)]
+        with pytest.raises(ValueError, match="ragged"):
+            procmesh.process_grid(devs)
+
+    def test_single_process_mesh_is_1d_data(self):
+        # the real in-process devices: conftest forces 8 CPU chips
+        mesh = procmesh.build_process_mesh()
+        assert mesh.axis_names == (DATA_AXIS,)
+        assert mesh.devices.shape == (len(jax.devices()),)
+
+    def test_mesh_tiers_two_tier(self):
+        grid = procmesh.process_grid(_fake_devices(2, 4))
+        mesh = types.SimpleNamespace(devices=grid,
+                                     axis_names=(DCN_AXIS, DATA_AXIS))
+        tiers = procmesh.mesh_tiers(mesh)
+        assert [(t["axis"], t["size"], t["tier"]) for t in tiers] == \
+            [(DCN_AXIS, 2, "dcn"), (DATA_AXIS, 4, "ici")]
+
+    def test_mesh_tiers_single_tier(self):
+        mesh = procmesh.build_process_mesh()
+        (tier,) = procmesh.mesh_tiers(mesh)
+        assert tier["tier"] == "ici"
+
+    def test_contiguous_mesh_passes(self):
+        grid = procmesh.process_grid(_fake_devices(2, 4))
+        mesh = types.SimpleNamespace(devices=grid,
+                                     axis_names=(DCN_AXIS, DATA_AXIS))
+        procmesh.assert_process_contiguous(mesh)
+
+    def test_row_spanning_two_processes_raises(self):
+        grid = procmesh.process_grid(_fake_devices(2, 2))
+        scrambled = grid.copy()
+        scrambled[0, 1], scrambled[1, 0] = grid[1, 0], grid[0, 1]
+        mesh = types.SimpleNamespace(devices=scrambled,
+                                     axis_names=(DCN_AXIS, DATA_AXIS))
+        with pytest.raises(ValueError, match="spans processes"):
+            procmesh.assert_process_contiguous(mesh)
+
+    def test_rows_out_of_process_order_raise(self):
+        grid = procmesh.process_grid(_fake_devices(2, 2))
+        mesh = types.SimpleNamespace(devices=grid[::-1],
+                                     axis_names=(DCN_AXIS, DATA_AXIS))
+        with pytest.raises(ValueError, match="process order"):
+            procmesh.assert_process_contiguous(mesh)
+
+
+class TestLocalRowBlock:
+    def test_single_process_owns_everything(self):
+        assert procmesh.local_row_block(16) == (0, 16)
+
+    def test_block_is_the_process_slice(self, monkeypatch):
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setattr(jax, "process_index", lambda: 2)
+        assert procmesh.local_row_block(16) == (8, 12)
+
+    def test_indivisible_batch_raises(self, monkeypatch):
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        with pytest.raises(ValueError, match="not divisible"):
+            procmesh.local_row_block(10)
+
+
+# ---------------------------------------------------------------------------
+# placement: shard_from_global / place (single-process semantics; the
+# cross-process no-collective property is exercised in
+# tests/test_multiprocess.py where it is actually load-bearing)
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_shard_from_global_reassembles_the_value(self):
+        mesh = procmesh.build_process_mesh()
+        sharding = NamedSharding(mesh, P(DATA_AXIS))
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        g = procmesh.shard_from_global(x, sharding)
+        assert g.shape == x.shape
+        np.testing.assert_array_equal(np.asarray(g), x)
+        # committed to the sharding — stepping on it won't re-place
+        assert g.sharding == sharding
+
+    def test_place_matches_device_put_single_process(self):
+        mesh = procmesh.build_process_mesh()
+        sharding = NamedSharding(mesh, P(DATA_AXIS))
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        np.testing.assert_array_equal(
+            np.asarray(procmesh.place(x, sharding)),
+            np.asarray(jax.device_put(x, sharding)))
+
+    def test_place_is_stable_on_committed_arrays(self):
+        mesh = procmesh.build_process_mesh()
+        sharding = NamedSharding(mesh, P())
+        x = procmesh.place(np.float32(3.5), sharding)
+        y = procmesh.place(x, sharding)
+        assert float(np.asarray(y)) == 3.5
+
+
+# ---------------------------------------------------------------------------
+# per-axis collective attribution (gspmd.collective_axis_bytes_from_hlo)
+# against the replica-group formats this XLA actually emits
+# ---------------------------------------------------------------------------
+
+def _tier_mesh():
+    """A fake (2, 4) (dcn, data) mesh — group_axes only reads
+    ``devices.shape`` and ``axis_names``."""
+    return types.SimpleNamespace(
+        devices=np.empty((2, 4), dtype=object),
+        axis_names=(DCN_AXIS, DATA_AXIS))
+
+
+class TestGroupAxes:
+    def test_explicit_groups_within_rows_are_data(self):
+        groups = gspmd_lib._parse_device_groups(
+            "  x = f32[4] all-reduce(y), replica_groups={{0,1,2,3},"
+            "{4,5,6,7}}, to_apply=add")
+        assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        assert gspmd_lib.group_axes(groups, _tier_mesh()) == (DATA_AXIS,)
+
+    def test_column_pairs_are_dcn(self):
+        groups = gspmd_lib._parse_device_groups(
+            "  x = f32[4] collective-permute(y), "
+            "source_target_pairs={{0,4},{4,0}}")
+        assert gspmd_lib.group_axes(groups, _tier_mesh()) == (DCN_AXIS,)
+
+    def test_global_group_spans_both_tiers(self):
+        groups = [[0, 1, 2, 3, 4, 5, 6, 7]]
+        assert gspmd_lib.group_axes(groups, _tier_mesh()) == \
+            (DCN_AXIS, DATA_AXIS)
+
+    def test_iota_v2_groups(self):
+        groups = gspmd_lib._parse_device_groups(
+            "  ar = f32[8] all-reduce(p), replica_groups=[2,4]<=[8], "
+            "to_apply=add")
+        assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_iota_v2_transposed_groups(self):
+        groups = gspmd_lib._parse_device_groups(
+            "  ar = f32[8] all-reduce(p), "
+            "replica_groups=[4,2]<=[2,4]T(1,0), to_apply=add")
+        # transpose pairs device p of row 0 with device p of row 1:
+        # the cross-process (dcn) tier
+        assert groups == [[0, 4], [1, 5], [2, 6], [3, 7]]
+        assert gspmd_lib.group_axes(groups, _tier_mesh()) == (DCN_AXIS,)
+
+    def test_line_without_groups_is_none(self):
+        assert gspmd_lib._parse_device_groups(
+            "  add = f32[4] add(a, b)") is None
+
+
+class TestCollectiveAxisBytes:
+    def test_labels_split_by_tier(self):
+        hlo = "\n".join([
+            "ENTRY main {",
+            "  ar0 = f32[1024]{0} all-reduce(g), "
+            "replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=add",
+            "  ar1 = f32[256]{0} all-reduce(h), "
+            "replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=add",
+            "  ar2 = f32[16]{0} all-reduce(i), "
+            "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=add",
+            "}",
+        ])
+        out = gspmd_lib.collective_axis_bytes_from_hlo(hlo, _tier_mesh())
+        assert set(out) == {DATA_AXIS, DCN_AXIS,
+                            f"{DCN_AXIS}+{DATA_AXIS}"}
+        assert out[DATA_AXIS]["bytes"] == 4096
+        assert out[DCN_AXIS]["bytes"] == 1024
+        assert out[f"{DCN_AXIS}+{DATA_AXIS}"]["bytes"] == 64
+        assert out[DATA_AXIS]["ops"] == {"all-reduce": 4096}
+
+    def test_groupless_collective_lands_in_replica(self):
+        hlo = ("  ar = f32[64]{0} all-reduce(g), to_apply=add\n")
+        out = gspmd_lib.collective_axis_bytes_from_hlo(hlo, _tier_mesh())
+        assert out == {"replica": {"calls": 1, "bytes": 256,
+                                   "ops": {"all-reduce": 256}}}
+
+    def test_agrees_with_untiered_totals(self):
+        """The per-axis split must partition the flat accounting —
+        same lines, same byte semantics, just bucketed."""
+        hlo = "\n".join([
+            "  ar = f32[1024]{0} all-reduce(g), "
+            "replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=add",
+            "  ag = f32[2048]{0} all-gather(p), "
+            "replica_groups={{0,4},{1,5},{2,6},{3,7}}, dimensions={0}",
+        ])
+        flat = gspmd_lib.collective_bytes_from_hlo(hlo)
+        tiered = gspmd_lib.collective_axis_bytes_from_hlo(
+            hlo, _tier_mesh())
+        assert sum(v["bytes"] for v in tiered.values()) == \
+            sum(v["bytes"] for v in flat.values())
+        assert sum(v["calls"] for v in tiered.values()) == \
+            sum(v["calls"] for v in flat.values())
+
+
+# ---------------------------------------------------------------------------
+# bench_scaling world parsing
+# ---------------------------------------------------------------------------
+
+def _bench_scaling():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "bench_scaling.py")
+    spec = importlib.util.spec_from_file_location("bench_scaling", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestParseWorlds:
+    def test_parses_the_sweep_grammar(self):
+        bs = _bench_scaling()
+        assert bs.parse_worlds("1x1,1x2,2x1,2x2") == \
+            [(1, 1), (1, 2), (2, 1), (2, 2)]
+
+    def test_rejects_garbage(self):
+        bs = _bench_scaling()
+        with pytest.raises(SystemExit):
+            bs.parse_worlds("2by2")
+        with pytest.raises(SystemExit):
+            bs.parse_worlds("0x2")
+        with pytest.raises(SystemExit):
+            bs.parse_worlds("")

@@ -383,6 +383,74 @@ def test_trend_cli_too_few_rounds(tmp_path):
     assert trend.main([str(tmp_path)]) == 2
 
 
+def _scaling_doc(eff_2x2, unattr=0.01):
+    return {"bench": "scaling", "model": "resnet18",
+            "baseline_world": "1x1",
+            "worlds": [
+                {"world": "1x1", "efficiency": 1.0,
+                 "img_per_sec_per_chip": 10.0, "step_ms_median": 100.0,
+                 "goodput": {"ratio": 0.9, "unattributed_frac": unattr}},
+                {"world": "2x2", "efficiency": eff_2x2,
+                 "img_per_sec_per_chip": 10.0 * eff_2x2,
+                 "step_ms_median": 100.0 / max(eff_2x2, 1e-9),
+                 "goodput": {"ratio": 0.85,
+                             "unattributed_frac": unattr}},
+            ],
+            "efficiency_curve": {"1x1": 1.0, "2x2": eff_2x2}}
+
+
+def test_trend_reads_scaling_rounds_per_world(tmp_path):
+    """SCALING_*.json sweeps join the trend as per-world series:
+    a bent efficiency curve is a regression (higher-is-better), a
+    cheaper step is not."""
+    (tmp_path / "SCALING_r01.json").write_text(
+        json.dumps(_scaling_doc(0.90)))
+    (tmp_path / "SCALING_r02.json").write_text(
+        json.dumps(_scaling_doc(0.70)))  # curve bent >5%: regression
+    paths = trend.find_rounds([str(tmp_path)])
+    assert [os.path.basename(p) for p in paths] == \
+        ["SCALING_r01.json", "SCALING_r02.json"]
+    report = trend.compare(trend.load_rounds(paths)[0])
+    assert "scaling.2x2.efficiency" in report["regressions"]
+    # step_ms got worse with the efficiency; lower-is-better catches it
+    assert "scaling.2x2.step_ms_median" in report["regressions"]
+    assert "scaling.1x1.efficiency" not in report["regressions"]
+    assert trend.direction("scaling.2x2.efficiency") == 1
+    assert trend.direction("scaling.2x2.goodput.unattributed_frac") == -1
+
+
+def test_trend_mixes_bench_and_scaling_rounds(tmp_path):
+    """BENCH and SCALING families coexist: disjoint key spaces, one
+    report."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"step_ms_gspmd": 100.0}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"step_ms_gspmd": 101.0}}))
+    (tmp_path / "SCALING_r01.json").write_text(
+        json.dumps(_scaling_doc(0.9)))
+    (tmp_path / "SCALING_r02.json").write_text(
+        json.dumps(_scaling_doc(0.91)))
+    report = trend.compare(trend.load_rounds(
+        trend.find_rounds([str(tmp_path)]))[0])
+    assert "step_ms_gspmd" in report["metrics"]
+    assert "scaling.2x2.efficiency" in report["metrics"]
+    assert report["regressions"] == []
+
+
+def test_trend_on_checked_in_scaling_round():
+    """The checked-in SCALING_r01.json parses into per-world metrics —
+    the sweep the repo ships must keep feeding the trend tool."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "SCALING_r01.json")
+    with open(path) as f:
+        metrics = trend.extract_metrics(json.load(f))
+    worlds = {k.split(".")[1] for k in metrics if k.startswith("scaling.")}
+    assert len(worlds) >= 2
+    for w in worlds:
+        assert f"scaling.{w}.efficiency" in metrics
+        assert metrics[f"scaling.{w}.goodput.unattributed_frac"] <= 0.02
+
+
 # -- the real capture (slow) -------------------------------------------------
 
 @pytest.mark.slow
